@@ -1,0 +1,33 @@
+"""music_analyst_tpu — a TPU-native music-lyrics analytics framework.
+
+A ground-up JAX/XLA/Pallas + C++ re-design of the capabilities of
+``VictorGSchneider/Music-Analyst-AI`` (reference mounted read-only at
+``/root/reference``):
+
+* parallel word-count / artist-count over the Spotify Million Song dataset
+  (reference: ``src/parallel_spotify.c``, MPI byte-range sharding + string
+  hash-table Send/Recv shuffle) — here: a C++ multithreaded host ingest that
+  produces a tokenized, HBM-resident id matrix, sharded over a
+  ``jax.sharding.Mesh`` with a single ``psum`` dense-histogram reduction;
+* LLM sentiment classification (reference:
+  ``scripts/sentiment_classifier.py``, one Ollama HTTP round-trip per song)
+  — here: batched on-device classifiers (vectorized ``--mock`` keyword
+  kernel, DistilBERT-sst2-style encoder, Llama-3-style decoder with
+  tensor-parallel sharded weights and KV cache);
+* per-song word counts, CSV column splitting, and performance-metrics
+  export with per-chip timings.
+
+Layer map (SURVEY.md §7):
+
+* ``data/``     — host ingest: CSV record reader, reference-exact tokenizers,
+                  vocabulary, native C++ bindings.
+* ``ops/``      — device compute: dense histogram, keyword-sentiment kernel,
+                  attention (incl. ring attention).
+* ``parallel/`` — mesh construction, sharding rules, collectives, multihost.
+* ``models/``   — Flax model families (encoder classifier, decoder LM).
+* ``engines/``  — end-to-end pipelines (wordcount, sentiment, per-song).
+* ``metrics/``  — timers + performance_metrics.json writer.
+* ``cli/``      — flag-compatible command-line surface.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""Flag-compatible command-line surface (reference L3, SURVEY.md §1)."""

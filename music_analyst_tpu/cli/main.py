@@ -1,0 +1,159 @@
+"""``python -m music_analyst_tpu`` — the framework's CLI.
+
+Four subcommands mirror the reference's four entry points (SURVEY.md §1 L3)
+with the same flags plus TPU-era additions (``--device``, ``--batch-size``):
+
+* ``analyze``   ≙ ``mpirun -np N bin/parallel_spotify dataset.csv``
+* ``sentiment`` ≙ ``scripts/sentiment_classifier.py``
+* ``wordcount-per-song`` ≙ ``scripts/word_count_per_song.py``
+* ``split``     ≙ ``scripts/split_csv_columns.py``
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def _add_analyze(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "analyze",
+        help="parallel word-count + artist-count over the dataset",
+    )
+    p.add_argument("dataset", help="Path to the spotify_millsongdata.csv dataset")
+    # Reference flags (src/parallel_spotify.c:756-767)
+    p.add_argument("--word-limit", type=int, default=0,
+                   help="Cap rows in word_counts.csv (0 = unlimited)")
+    p.add_argument("--artist-limit", type=int, default=0,
+                   help="Cap rows in top_artists.csv (0 = unlimited)")
+    p.add_argument("--output-dir", default="output")
+    # TPU-era additions
+    p.add_argument("--limit", type=int, default=None,
+                   help="Only process the first N songs")
+    p.add_argument("--ingest", choices=("auto", "native", "python"), default="auto")
+    p.add_argument("--no-split", action="store_true",
+                   help="Skip writing split_columns/ artifacts")
+    p.add_argument("--devices", type=int, default=None,
+                   help="Use only the first N devices of the mesh")
+
+
+def _add_sentiment(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("sentiment", help="batched sentiment classification")
+    p.add_argument("dataset")
+    # Reference flags (scripts/sentiment_classifier.py:128-136)
+    p.add_argument("--model", default="llama3",
+                   help="Model family: mock, distilbert[-*], llama[3*]")
+    p.add_argument("--limit", type=int, default=None)
+    p.add_argument("--output-dir", default="output")
+    p.add_argument("--mock", action="store_true",
+                   help="Keyword-kernel backend (no model weights needed)")
+    # TPU-era additions
+    p.add_argument("--batch-size", type=int, default=4096)
+
+
+def _add_wordcount_per_song(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "wordcount-per-song",
+        help="serial per-song word counts (independent oracle)",
+    )
+    # Reference flags (scripts/word_count_per_song.py:52-81)
+    p.add_argument("csv_path")
+    p.add_argument("--output-dir", default="output/serial_word_counts")
+    p.add_argument("--encoding", default="utf-8-sig")
+    p.add_argument("--delimiter", default=None)
+    p.add_argument("--workers", type=int, default=0)
+
+
+def _add_split(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("split", help="split a CSV into one file per column")
+    # Reference flags (scripts/split_csv_columns.py:73-114)
+    p.add_argument("csv_path")
+    p.add_argument("--output-dir", default=None)
+    p.add_argument("--delimiter", default=None)
+    p.add_argument("--quotechar", default='"')
+    p.add_argument("--encoding", default="utf-8-sig")
+    p.add_argument("--no-header", action="store_true")
+    p.add_argument("--force", action="store_true")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="music_analyst_tpu",
+        description="TPU-native Spotify lyrics analytics",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    _add_analyze(sub)
+    _add_sentiment(sub)
+    _add_wordcount_per_song(sub)
+    _add_split(sub)
+    args = parser.parse_args(argv)
+
+    if args.command == "analyze":
+        from music_analyst_tpu.engines.wordcount import run_analysis
+        from music_analyst_tpu.parallel.mesh import data_parallel_mesh
+
+        mesh = data_parallel_mesh(args.devices) if args.devices else None
+        run_analysis(
+            args.dataset,
+            output_dir=args.output_dir,
+            word_limit=args.word_limit,
+            artist_limit=args.artist_limit,
+            limit=args.limit,
+            mesh=mesh,
+            write_split=not args.no_split,
+            ingest_backend=args.ingest,
+        )
+        return 0
+
+    if args.command == "sentiment":
+        from music_analyst_tpu.engines.sentiment import run_sentiment
+
+        run_sentiment(
+            args.dataset,
+            model=args.model,
+            mock=args.mock,
+            limit=args.limit,
+            output_dir=args.output_dir,
+            batch_size=args.batch_size,
+        )
+        return 0
+
+    if args.command == "wordcount-per-song":
+        from music_analyst_tpu.engines.persong import run_per_song_wordcount
+
+        run_per_song_wordcount(
+            args.csv_path,
+            output_dir=args.output_dir,
+            encoding=args.encoding,
+            delimiter=args.delimiter,
+            workers=args.workers,
+        )
+        return 0
+
+    if args.command == "split":
+        from music_analyst_tpu.data.splitter import split_csv_columns
+
+        out_dir, names = split_csv_columns(
+            args.csv_path,
+            output_dir=args.output_dir,
+            delimiter=args.delimiter,
+            quotechar=args.quotechar,
+            encoding=args.encoding,
+            no_header=args.no_header,
+            force=args.force,
+        )
+        print(f"Concluído. {len(names)} arquivo(s) gerado(s) em: {out_dir}")
+        for name in names:
+            print(f" - {out_dir / name}")
+        return 0
+
+    return 1
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except Exception as exc:  # top-level error reporting, like the reference
+        print(f"Error: {exc}", file=sys.stderr)
+        raise

@@ -1,0 +1,12 @@
+"""Host-side data plane: CSV ingest, tokenization, vocabulary.
+
+The reference's L1 data plane (SURVEY.md §1) is a C CSV record
+reader/splitter plus a byte-wise tokenizer inside an MPI binary
+(``/root/reference/src/parallel_spotify.c:258-304,549-633,350-394``). Here the
+data plane is a standalone host library: pure-Python reference
+implementations (exact semantics, used for parity tests and small inputs) and
+a multithreaded C++ fast path (``native/``) that feeds device buffers.
+"""
+
+from music_analyst_tpu.data.tokenizer import tokenize_ascii, tokenize_latin1
+from music_analyst_tpu.data.vocab import Vocab

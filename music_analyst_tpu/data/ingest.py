@@ -1,0 +1,101 @@
+"""Corpus ingest: dataset file → device-ready dense arrays.
+
+The host half of the word/artist-count pipeline.  Produces the exact same
+aggregates the reference's per-rank loops feed into hash tables
+(``src/parallel_spotify.c:918-998``), but as dense id arrays ready to be
+sharded over a mesh:
+
+* word ids: every >=3-byte token of every lyric, C-tokenizer semantics;
+* artist ids: one id per record with a non-empty artist, ``-1`` otherwise
+  (empty-artist records still count toward the song total — SURVEY.md §5
+  contract #3);
+* vocabularies mapping ids back to strings for the host-side sort/export.
+
+Backends: ``python`` (reference-exact oracle, this module) and ``native``
+(multithreaded C++, ``data/native.py``); ``auto`` prefers native.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from music_analyst_tpu.data.csv_io import iter_dataset_exact
+from music_analyst_tpu.data.tokenizer import tokenize_ascii
+from music_analyst_tpu.data.vocab import Vocab
+
+
+@dataclasses.dataclass
+class IngestResult:
+    """Dense host-side corpus representation."""
+
+    word_vocab: Vocab
+    word_ids: np.ndarray       # int32 [total_tokens]
+    word_offsets: np.ndarray   # int64 [songs+1] — song s owns ids[off[s]:off[s+1]]
+    artist_vocab: Vocab
+    artist_ids: np.ndarray     # int32 [songs], -1 for empty artist
+    song_count: int
+
+    @property
+    def token_count(self) -> int:
+        return int(self.word_ids.shape[0])
+
+    def tokens_per_song(self) -> np.ndarray:
+        return np.diff(self.word_offsets)
+
+
+def ingest_python(
+    data: bytes,
+    limit: Optional[int] = None,
+) -> IngestResult:
+    """Pure-Python reference-exact ingest (oracle for the native path)."""
+    word_vocab = Vocab()
+    artist_vocab = Vocab()
+    word_add = word_vocab.add
+    ids: List[int] = []
+    offsets: List[int] = [0]
+    artist_ids: List[int] = []
+    for i, (artist_raw, text_raw) in enumerate(iter_dataset_exact(data)):
+        if limit is not None and i >= limit:
+            break
+        ids.extend(word_add(tok) for tok in tokenize_ascii(text_raw))
+        offsets.append(len(ids))
+        if artist_raw:
+            artist = artist_raw.decode("utf-8", errors="replace")
+            artist_ids.append(artist_vocab.add(artist))
+        else:
+            artist_ids.append(-1)
+    return IngestResult(
+        word_vocab=word_vocab,
+        word_ids=np.asarray(ids, dtype=np.int32),
+        word_offsets=np.asarray(offsets, dtype=np.int64),
+        artist_vocab=artist_vocab,
+        artist_ids=np.asarray(artist_ids, dtype=np.int32),
+        song_count=len(artist_ids),
+    )
+
+
+def ingest_dataset(
+    path: str,
+    limit: Optional[int] = None,
+    backend: str = "auto",
+    num_threads: int = 0,
+) -> IngestResult:
+    """Ingest a dataset CSV with the requested backend."""
+    if backend not in ("auto", "python", "native"):
+        raise ValueError(f"unknown ingest backend: {backend}")
+    if backend in ("auto", "native"):
+        from music_analyst_tpu.data import native
+
+        if native.available():
+            return native.ingest_native(path, limit=limit, num_threads=num_threads)
+        if backend == "native":
+            raise RuntimeError(
+                "native ingest requested but the C++ library is unavailable "
+                f"({native.unavailable_reason()})"
+            )
+    with open(path, "rb") as fh:
+        data = fh.read()
+    return ingest_python(data, limit=limit)

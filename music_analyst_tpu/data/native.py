@@ -1,0 +1,158 @@
+"""ctypes bindings for the native C++ ingest library.
+
+The reference's hot path is native C (``src/parallel_spotify.c``); this
+framework keeps the host-side hot path native too — a multithreaded C++
+scanner/tokenizer (``native/ingest.cpp``) that byte-partitions the dataset
+across threads with record-exact boundary handling and merges per-thread
+vocabularies.  Python only sees dense numpy arrays.
+
+The library is built on demand with ``make -C native`` (plain g++, no
+external deps).  Every entry point degrades gracefully: if the library is
+missing and cannot be built, callers fall back to the pure-Python ingest.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libmusicaal.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_error: Optional[str] = None
+
+
+def _try_build() -> None:
+    subprocess.run(
+        ["make", "-C", _NATIVE_DIR, "-s"],
+        check=True,
+        capture_output=True,
+        timeout=300,
+    )
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_error
+    with _lock:
+        if _lib is not None or _load_error is not None:
+            return _lib
+        try:
+            if not os.path.exists(_LIB_PATH):
+                _try_build()
+            lib = ctypes.CDLL(_LIB_PATH)
+        except Exception as exc:  # missing toolchain, build failure, ...
+            _load_error = str(exc)
+            return None
+        lib.man_ingest.restype = ctypes.c_void_p
+        lib.man_ingest.argtypes = [ctypes.c_char_p, ctypes.c_longlong, ctypes.c_int]
+        lib.man_error.restype = ctypes.c_char_p
+        lib.man_error.argtypes = [ctypes.c_void_p]
+        lib.man_song_count.restype = ctypes.c_longlong
+        lib.man_song_count.argtypes = [ctypes.c_void_p]
+        lib.man_token_count.restype = ctypes.c_longlong
+        lib.man_token_count.argtypes = [ctypes.c_void_p]
+        lib.man_word_vocab_size.restype = ctypes.c_int
+        lib.man_word_vocab_size.argtypes = [ctypes.c_void_p]
+        lib.man_artist_vocab_size.restype = ctypes.c_int
+        lib.man_artist_vocab_size.argtypes = [ctypes.c_void_p]
+        lib.man_word_vocab_bytes.restype = ctypes.c_longlong
+        lib.man_word_vocab_bytes.argtypes = [ctypes.c_void_p]
+        lib.man_artist_vocab_bytes.restype = ctypes.c_longlong
+        lib.man_artist_vocab_bytes.argtypes = [ctypes.c_void_p]
+        lib.man_copy_word_ids.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.man_copy_word_offsets.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.man_copy_artist_ids.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        # Vocab wire format is length-prefixed (concatenated UTF-8 bytes +
+        # an int32 length per token) — artist names may legally contain
+        # newlines, so a delimiter-based format would corrupt the mapping.
+        lib.man_copy_word_vocab.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.man_copy_artist_vocab.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.man_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def unavailable_reason() -> str:
+    _load()
+    return _load_error or "unknown"
+
+
+def ingest_native(path: str, limit: Optional[int] = None, num_threads: int = 0):
+    """Run the C++ ingest and wrap the results as an :class:`IngestResult`."""
+    from music_analyst_tpu.data.ingest import IngestResult
+    from music_analyst_tpu.data.vocab import Vocab
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_load_error}")
+    handle = lib.man_ingest(
+        path.encode("utf-8"),
+        ctypes.c_longlong(-1 if limit is None else limit),
+        ctypes.c_int(num_threads),
+    )
+    if not handle:
+        raise RuntimeError("native ingest failed to allocate")
+    try:
+        err = lib.man_error(handle)
+        if err:
+            raise RuntimeError(f"native ingest: {err.decode()}")
+        songs = lib.man_song_count(handle)
+        tokens = lib.man_token_count(handle)
+        word_ids = np.empty(tokens, dtype=np.int32)
+        word_offsets = np.empty(songs + 1, dtype=np.int64)
+        artist_ids = np.empty(songs, dtype=np.int32)
+        if tokens:
+            lib.man_copy_word_ids(handle, word_ids.ctypes.data_as(ctypes.c_void_p))
+        lib.man_copy_word_offsets(handle, word_offsets.ctypes.data_as(ctypes.c_void_p))
+        if songs:
+            lib.man_copy_artist_ids(handle, artist_ids.ctypes.data_as(ctypes.c_void_p))
+        def _read_vocab(count: int, total_bytes: int, copy_fn) -> list:
+            if count == 0:
+                return []
+            buf = ctypes.create_string_buffer(max(1, total_bytes))
+            lens = np.empty(count, dtype=np.int32)
+            copy_fn(handle, buf, lens.ctypes.data_as(ctypes.c_void_p))
+            blob = buf.raw[:total_bytes]
+            tokens = []
+            pos = 0
+            for n in lens.tolist():
+                tokens.append(blob[pos : pos + n].decode("utf-8", errors="replace"))
+                pos += n
+            return tokens
+
+        word_tokens = _read_vocab(
+            lib.man_word_vocab_size(handle),
+            lib.man_word_vocab_bytes(handle),
+            lib.man_copy_word_vocab,
+        )
+        artist_tokens = _read_vocab(
+            lib.man_artist_vocab_size(handle),
+            lib.man_artist_vocab_bytes(handle),
+            lib.man_copy_artist_vocab,
+        )
+        return IngestResult(
+            word_vocab=Vocab(word_tokens),
+            word_ids=word_ids,
+            word_offsets=word_offsets,
+            artist_vocab=Vocab(artist_tokens),
+            artist_ids=artist_ids,
+            song_count=int(songs),
+        )
+    finally:
+        lib.man_free(handle)

@@ -1,0 +1,238 @@
+"""CSV column splitting: the dataset preprocessor and the generic tool.
+
+Two distinct splitters exist in the reference and both are reproduced:
+
+* the in-process dataset splitter the analysis binary runs on rank 0
+  (``src/parallel_spotify.c:640-721``): writes
+  ``split_columns/<artist>.csv`` + ``<text>.csv``, one record per line with
+  the original quoting preserved, header label as first line;
+* the standalone generic splitter (``scripts/split_csv_columns.py``): one
+  file per column of any CSV, named after the sanitized header, with
+  collision suffixes, ``--no-header`` / ``--force`` support.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import re
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+from music_analyst_tpu.data.csv_io import (
+    iter_csv_records_exact,
+    parse_record_exact,
+)
+
+
+def sanitize_header_name(name: str) -> str:
+    """Header → filename base, C-binary semantics.
+
+    Reference ``src/parallel_spotify.c:510-543``: drop CR/LF, map other
+    whitespace and non ``[A-Za-z0-9-._]`` ASCII chars to ``_``; empty result
+    falls back to ``"col"``.  (Non-ASCII bytes are "not alnum" to the C
+    locale, so every byte of a multi-byte char becomes ``_``.)
+    """
+    out = []
+    for byte in name.encode("utf-8", errors="surrogateescape"):
+        ch = chr(byte)
+        if ch in "\r\n":
+            continue
+        if ch in " \t\v\f":
+            out.append("_")
+        elif ch.isascii() and (ch.isalnum() or ch in "-._"):
+            out.append(ch)
+        else:
+            out.append("_")
+    return "".join(out) or "col"
+
+
+def sanitize_filename(name: str, max_len: int = 80) -> str:
+    """Header → filename base, generic-tool semantics.
+
+    Reference ``scripts/split_csv_columns.py:25-29``: newlines → spaces,
+    non ``[\\w\\-. ]`` → ``_`` (Unicode word chars allowed), whitespace runs
+    → ``_``, truncated to ``max_len``, fallback ``"col"``.
+    """
+    name = (name or "").replace("\n", " ").replace("\r", " ").strip()
+    name = re.sub(r"[^\w\-. ]+", "_", name, flags=re.UNICODE)
+    name = re.sub(r"\s+", "_", name)
+    return (name or "col")[:max_len]
+
+
+def split_dataset_columns(
+    dataset_path: str,
+    split_dir: str,
+    artist_base_name: str,
+    text_base_name: str,
+    artist_header_label: str,
+    text_header_label: str,
+) -> Tuple[str, str]:
+    """Write ``<split_dir>/<artist>.csv`` and ``<text>.csv``.
+
+    Matches the reference splitter (``src/parallel_spotify.c:640-721``):
+    header label (or ``Artists``/``Texts`` fallback) on the first line, then
+    one record per data row with outer quotes preserved verbatim; records
+    with fewer than three unquoted commas are skipped.
+    """
+    os.makedirs(split_dir, exist_ok=True)
+    artist_path = os.path.join(split_dir, artist_base_name + ".csv")
+    text_path = os.path.join(split_dir, text_base_name + ".csv")
+    with open(dataset_path, "rb") as fh:
+        data = fh.read()
+    records = iter_csv_records_exact(data)
+    next(records, None)  # header row
+    with open(artist_path, "wb") as artist_fp, open(text_path, "wb") as text_fp:
+        artist_fp.write((artist_header_label or "Artists").encode("utf-8") + b"\n")
+        text_fp.write((text_header_label or "Texts").encode("utf-8") + b"\n")
+        for record in records:
+            if not record.strip(b"\r\n"):
+                continue
+            parsed = parse_record_exact(
+                record, preserve_artist_quotes=True, preserve_text_quotes=True
+            )
+            if parsed is None:
+                continue
+            artist_raw, text_raw = parsed
+            artist_fp.write(artist_raw + b"\n")
+            text_fp.write(text_raw + b"\n")
+    return artist_path, text_path
+
+
+def read_header_labels(dataset_path: str) -> Tuple[str, str]:
+    """Artist/text header labels from the dataset's first record.
+
+    Mirrors the rank-0 preamble (``src/parallel_spotify.c:788-819``): parse
+    the header record with quotes stripped; raises ``ValueError`` when the
+    header can't be parsed (the reference aborts).
+    """
+    with open(dataset_path, "rb") as fh:
+        data = fh.read()
+    header = next(iter_csv_records_exact(data), None)
+    if header is None:
+        raise ValueError("Dataset does not contain a header row")
+    parsed = parse_record_exact(header)
+    if parsed is None:
+        raise ValueError("Unable to parse dataset header")
+    artist_label, text_label = parsed
+    return (
+        artist_label.decode("utf-8", errors="replace"),
+        text_label.decode("utf-8", errors="replace"),
+    )
+
+
+def split_csv_columns(
+    csv_path: str,
+    output_dir: Optional[str] = None,
+    delimiter: Optional[str] = None,
+    quotechar: str = '"',
+    encoding: str = "utf-8-sig",
+    no_header: bool = False,
+    force: bool = False,
+) -> Tuple[Path, List[str]]:
+    """Generic one-file-per-column splitter.
+
+    Behavioral clone of ``scripts/split_csv_columns.py:117-206``: sniffed
+    delimiter (64 KiB sample, fallback ``,``), sanitized header filenames
+    with ``_2, _3…`` collision suffixes, header row re-emitted into each
+    column file unless ``no_header``.
+    """
+    in_path = Path(csv_path)
+    if not in_path.exists():
+        raise FileNotFoundError(str(in_path))
+    base_out = (
+        Path(output_dir)
+        if output_dir
+        else in_path.with_suffix("").parent / f"{in_path.stem}_columns"
+    )
+    base_out.mkdir(parents=True, exist_ok=True)
+
+    with open(in_path, "r", encoding=encoding, newline="") as fh:
+        if delimiter:
+            fmt = dict(
+                delimiter=delimiter,
+                quotechar=quotechar,
+                doublequote=True,
+                skipinitialspace=False,
+                lineterminator="\n",
+                quoting=csv.QUOTE_MINIMAL,
+            )
+        else:
+            pos = fh.tell()
+            sample = fh.read(65536)
+            fh.seek(pos)
+            try:
+                dialect = csv.Sniffer().sniff(sample)
+                fmt = dict(
+                    delimiter=dialect.delimiter,
+                    quotechar=quotechar or '"',
+                    doublequote=True,
+                    skipinitialspace=dialect.skipinitialspace,
+                    lineterminator="\n",
+                    quoting=csv.QUOTE_MINIMAL,
+                )
+            except csv.Error:
+                fmt = dict(
+                    delimiter=",",
+                    quotechar=quotechar or '"',
+                    doublequote=True,
+                    skipinitialspace=False,
+                    lineterminator="\n",
+                    quoting=csv.QUOTE_MINIMAL,
+                )
+        reader = csv.reader(fh, **fmt)
+        try:
+            first_row = next(reader)
+        except StopIteration:
+            raise ValueError("empty CSV")
+
+        if no_header:
+            headers = [f"col{i + 1}" for i in range(len(first_row))]
+            first_data_row: Optional[List[str]] = first_row
+        else:
+            headers = [
+                (h if h is not None and str(h).strip() else f"col{i + 1}")
+                for i, h in enumerate(first_row)
+            ]
+            first_data_row = None
+
+        num_cols = len(headers)
+        seen: set = set()
+        filenames: List[str] = []
+        for i, h in enumerate(headers, start=1):
+            name = sanitize_filename(str(h)) or f"col{i}"
+            candidate = f"{name}.csv"
+            k = 2
+            while candidate.lower() in seen or (
+                (base_out / candidate).exists() and not force
+            ):
+                candidate = f"{name}_{k}.csv"
+                k += 1
+            seen.add(candidate.lower())
+            filenames.append(candidate)
+
+        files = []
+        writers = []
+        try:
+            for i in range(num_cols):
+                fh_out = open(base_out / filenames[i], "w", encoding=encoding, newline="")
+                writer = csv.writer(fh_out, **fmt)
+                if not no_header:
+                    writer.writerow([headers[i]])
+                files.append(fh_out)
+                writers.append(writer)
+            if first_data_row is not None:
+                for i in range(num_cols):
+                    writers[i].writerow(
+                        [first_data_row[i] if i < len(first_data_row) else ""]
+                    )
+            for row in reader:
+                for i in range(num_cols):
+                    writers[i].writerow([row[i] if i < len(row) else ""])
+        finally:
+            for fh_out in files:
+                try:
+                    fh_out.close()
+                except Exception:
+                    pass
+    return base_out, filenames

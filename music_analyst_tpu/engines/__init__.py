@@ -1,0 +1,1 @@
+"""End-to-end pipelines wiring data → mesh → ops → artifacts."""

@@ -1,0 +1,103 @@
+"""Per-song word counts — the serial/threaded oracle tool.
+
+Behavioral clone of ``scripts/word_count_per_song.py`` (SURVEY.md §2.2
+P7/P8): Latin-1-aware regex tokenizer, thread-pool row processing, two
+artifacts — ``word_counts_by_song.csv`` streamed in row order and
+``word_counts_global.csv`` via ``Counter.most_common()`` (ties in insertion
+order, deliberately *not* the strcmp tie-break of the parallel engine —
+that divergence exists in the reference and is preserved).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from collections import Counter
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+from typing import Dict, Optional, Tuple
+
+from music_analyst_tpu.data.tokenizer import tokenize_latin1
+
+
+def detect_delimiter(sample: str) -> str:
+    """``csv.Sniffer`` over the sample, fallback ``,`` (reference :42-49)."""
+    try:
+        return csv.Sniffer().sniff(sample).delimiter
+    except csv.Error:
+        return ","
+
+
+def resolve_workers(requested: int) -> int:
+    """0/negative → one thread per CPU (reference :84-88)."""
+    if requested and requested > 0:
+        return requested
+    return max(1, os.cpu_count() or 1)
+
+
+def process_row(row: Dict[str, str]) -> Optional[Tuple[str, str, Counter]]:
+    """Tokenize one row; ``None`` when the lyric has no tokens (ref :91-99)."""
+    artist = (row.get("artist") or "").strip()
+    song = (row.get("song") or "").strip()
+    text = row.get("text") or ""
+    word_counter: Counter = Counter(tokenize_latin1(text))
+    if not word_counter:
+        return None
+    return artist, song, word_counter
+
+
+def run_per_song_wordcount(
+    csv_path: str,
+    output_dir: str = "output/serial_word_counts",
+    encoding: str = "utf-8-sig",
+    delimiter: Optional[str] = None,
+    workers: int = 0,
+    quiet: bool = False,
+) -> Tuple[Path, Path, int]:
+    """Write both artifacts; returns their paths and the row count."""
+    src = Path(csv_path)
+    if not src.exists():
+        raise FileNotFoundError(str(src))
+    out = Path(output_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    global_path = out / "word_counts_global.csv"
+    per_song_path = out / "word_counts_by_song.csv"
+
+    with open(src, "r", encoding=encoding, newline="") as fh:
+        sample = fh.read(65536)
+        fh.seek(0)
+        delim = delimiter or detect_delimiter(sample)
+        reader = csv.DictReader(fh, delimiter=delim)
+        required = {"artist", "song", "text"}
+        if not required.issubset(reader.fieldnames or {}):
+            raise ValueError(
+                "CSV is missing expected columns: artist, song, text"
+            )
+
+        global_counter: Counter = Counter()
+        total_rows = 0
+        with open(per_song_path, "w", encoding="utf-8", newline="") as ps_fh:
+            per_song_writer = csv.writer(ps_fh)
+            per_song_writer.writerow(["artist", "song", "word", "count"])
+            # Same split of work as the reference (:132-140): tokenization in
+            # the pool, the fold + write on the main thread, chunksize 32.
+            with ThreadPoolExecutor(max_workers=resolve_workers(workers)) as pool:
+                for result in pool.map(process_row, reader, chunksize=32):
+                    total_rows += 1
+                    if result is None:
+                        continue
+                    artist, song, word_counter = result
+                    for word, count in word_counter.items():
+                        global_counter[word] += count
+                        per_song_writer.writerow([artist, song, word, count])
+
+    with open(global_path, "w", encoding="utf-8", newline="") as g_fh:
+        writer = csv.writer(g_fh)
+        writer.writerow(["word", "count"])
+        writer.writerows(global_counter.most_common())
+
+    if not quiet:
+        print("Concluído. Processadas", total_rows, "linhas. Arquivos gerados em", os.fspath(out))
+        print(" -", os.fspath(global_path))
+        print(" -", os.fspath(per_song_path))
+    return global_path, per_song_path, total_rows

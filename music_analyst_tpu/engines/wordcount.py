@@ -1,0 +1,161 @@
+"""The word/artist-count analysis engine (``bin/parallel_spotify`` parity).
+
+Pipeline (cf. the reference call stack, SURVEY.md §3.1):
+
+1. preprocessing — header labels + column split artifacts
+   (``output/split_columns/<artist>.csv``, ``<text>.csv``), exactly like
+   rank 0 of the reference (``src/parallel_spotify.c:778-828``);
+2. host ingest — C++/Python tokenizer builds vocab + dense id arrays
+   (replaces the per-rank byte-slice read loops, ``:918-998``);
+3. device compute — id shards over the mesh ``dp`` axis, per-chip dense
+   histogram, one ``psum`` (replaces hash-table Send/Recv + rank-0 merge,
+   ``:1002-1065``);
+4. export — count-desc/strcmp-asc sorted CSVs, console report, and
+   ``performance_metrics.json`` with per-chip timings
+   (``:1027-1053,1084-1109``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from music_analyst_tpu.data.csv_io import sort_count_entries, write_count_csv
+from music_analyst_tpu.data.ingest import IngestResult, ingest_dataset
+from music_analyst_tpu.data.splitter import (
+    read_header_labels,
+    sanitize_header_name,
+    split_dataset_columns,
+)
+from music_analyst_tpu.metrics.perf import TimeStats, write_performance_metrics
+from music_analyst_tpu.metrics.timer import StageTimer
+from music_analyst_tpu.ops.histogram import sharded_histogram
+from music_analyst_tpu.parallel.mesh import data_parallel_mesh
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    word_entries: List[Tuple[str, int]]    # sorted count-desc, tie bytewise-asc
+    artist_entries: List[Tuple[str, int]]
+    total_songs: int
+    total_words: int
+    timings: dict
+    output_paths: dict
+
+
+def run_analysis(
+    dataset_path: str,
+    output_dir: str = "output",
+    word_limit: int = 0,
+    artist_limit: int = 0,
+    limit: Optional[int] = None,
+    mesh=None,
+    write_split: bool = True,
+    ingest_backend: str = "auto",
+    quiet: bool = False,
+) -> AnalysisResult:
+    """Run the full analysis and write the reference's output artifacts."""
+    timer = StageTimer()
+    os.makedirs(output_dir, exist_ok=True)
+    split_dir = os.path.join(output_dir, "split_columns")
+
+    with timer.stage("split"):
+        if write_split:
+            artist_label, text_label = read_header_labels(dataset_path)
+            split_dataset_columns(
+                dataset_path,
+                split_dir,
+                sanitize_header_name(artist_label),
+                sanitize_header_name(text_label),
+                artist_label,
+                text_label,
+            )
+
+    with timer.stage("ingest"):
+        corpus: IngestResult = ingest_dataset(
+            dataset_path, limit=limit, backend=ingest_backend
+        )
+
+    if mesh is None:
+        mesh = data_parallel_mesh()
+
+    with timer.stage("device_compute"):
+        word_counts = sharded_histogram(
+            corpus.word_ids, max(1, len(corpus.word_vocab)), mesh
+        )
+        artist_counts = sharded_histogram(
+            corpus.artist_ids, max(1, len(corpus.artist_vocab)), mesh
+        )
+        jax.block_until_ready((word_counts, artist_counts))
+    # Grand totals are already global on the host (the reference needs an
+    # MPI_Reduce only because each rank holds a partial count).
+    total_words = corpus.token_count
+    total_songs = corpus.song_count
+
+    with timer.stage("aggregate_export"):
+        word_entries = sort_count_entries(
+            corpus.word_vocab.counts_to_entries(np.asarray(word_counts))
+        )
+        artist_entries = sort_count_entries(
+            corpus.artist_vocab.counts_to_entries(np.asarray(artist_counts))
+        )
+        word_path = os.path.join(output_dir, "word_counts.csv")
+        artist_path = os.path.join(output_dir, "top_artists.csv")
+        write_count_csv(word_path, "word", word_entries, word_limit)
+        write_count_csv(artist_path, "artist", artist_entries, artist_limit)
+
+    # Reference timing semantics (src/parallel_spotify.c:850-851,1000,1068):
+    # compute = local read+count; total = compute + aggregation/export.
+    compute_seconds = timer.total("ingest", "device_compute")
+    total_seconds = timer.total("ingest", "device_compute", "aggregate_export")
+    metrics_path = os.path.join(output_dir, "performance_metrics.json")
+    devices = mesh.devices.flatten().tolist()
+    write_performance_metrics(
+        metrics_path,
+        processes=len(devices),
+        total_songs=total_songs,
+        total_words=total_words,
+        compute_time=TimeStats.uniform(compute_seconds),
+        total_time=TimeStats.uniform(total_seconds),
+        per_chip=[
+            {
+                "device": str(d),
+                "platform": d.platform,
+                "compute_seconds": round(timer.seconds.get("device_compute", 0.0), 6),
+            }
+            for d in devices
+        ],
+        stages=dict(timer.seconds),
+        device_platform=devices[0].platform if devices else "unknown",
+    )
+
+    if not quiet:
+        print("=== Parallel Spotify Analysis ===")
+        print(f"Total songs processed: {total_songs}")
+        print(f"Total words counted: {total_words}")
+        preview_words = word_entries[:10]
+        print(f"Top {len(preview_words)} words:")
+        for key, value in preview_words:
+            print(f"  {key}: {value}")
+        preview_artists = artist_entries[:10]
+        print(f"Top {len(preview_artists)} artists:")
+        for key, value in preview_artists:
+            print(f"  {key}: {value} songs")
+
+    return AnalysisResult(
+        word_entries=word_entries,
+        artist_entries=artist_entries,
+        total_songs=total_songs,
+        total_words=total_words,
+        timings=dict(timer.seconds),
+        output_paths={
+            "word_counts": word_path,
+            "top_artists": artist_path,
+            "performance_metrics": metrics_path,
+            "split_dir": split_dir,
+        },
+    )

@@ -1,0 +1,4 @@
+"""Timing capture and performance-metrics export."""
+
+from music_analyst_tpu.metrics.perf import TimeStats, write_performance_metrics
+from music_analyst_tpu.metrics.timer import StageTimer

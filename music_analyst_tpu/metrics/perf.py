@@ -1,0 +1,69 @@
+"""``performance_metrics.json`` writer — reference schema, superset fields.
+
+The reference writes ``{processes, total_songs, total_words,
+compute_time{avg,min,max_seconds}, total_time{...}}`` by hand-formatted
+fprintf (``src/parallel_spotify.c:1084-1109``).  This writer reproduces that
+schema exactly (keys, nesting, 6-decimal seconds) and appends the TPU-era
+extensions required by the north star: a per-chip timing column, device
+platform info, and stage breakdowns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeStats:
+    avg_seconds: float
+    min_seconds: float
+    max_seconds: float
+
+    @classmethod
+    def uniform(cls, seconds: float) -> "TimeStats":
+        """SPMD timing: one synchronous program — avg == min == max.
+
+        The reference's per-rank min/avg/max spread comes from MPI ranks
+        running asynchronously (``src/parallel_spotify.c:1077-1082``); a
+        jitted SPMD program is lock-stepped across chips, so the three
+        statistics legitimately coincide.
+        """
+        return cls(seconds, seconds, seconds)
+
+    def as_dict(self) -> Dict[str, float]:
+        return {
+            "avg_seconds": round(self.avg_seconds, 6),
+            "min_seconds": round(self.min_seconds, 6),
+            "max_seconds": round(self.max_seconds, 6),
+        }
+
+
+def write_performance_metrics(
+    path: str,
+    processes: int,
+    total_songs: int,
+    total_words: int,
+    compute_time: TimeStats,
+    total_time: TimeStats,
+    per_chip: Optional[List[Dict[str, Any]]] = None,
+    stages: Optional[Dict[str, float]] = None,
+    device_platform: Optional[str] = None,
+) -> None:
+    payload: Dict[str, Any] = {
+        "processes": processes,
+        "total_songs": total_songs,
+        "total_words": total_words,
+        "compute_time": compute_time.as_dict(),
+        "total_time": total_time.as_dict(),
+    }
+    if device_platform is not None:
+        payload["device_platform"] = device_platform
+    if per_chip is not None:
+        payload["per_chip"] = per_chip
+    if stages is not None:
+        payload["stages"] = {k: round(v, 6) for k, v in stages.items()}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2)
+        fh.write("\n")

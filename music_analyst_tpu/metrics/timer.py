@@ -1,0 +1,37 @@
+"""Wall-clock stage timers.
+
+The reference brackets compute and total with ``MPI_Wtime``
+(``src/parallel_spotify.c:850-851,1000,1067-1068``).  Under single-controller
+JAX the host drives every chip, so stage timing is host wall-clock around
+blocking device calls (``block_until_ready``) — which is also the honest
+apples-to-apples definition when comparing against the MPI binary
+(SURVEY.md §7 "Timing semantics").
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict, Iterator
+
+
+class StageTimer:
+    """Accumulates named wall-clock stage durations."""
+
+    def __init__(self) -> None:
+        self.seconds: Dict[str, float] = {}
+
+    @contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds[name] = self.seconds.get(name, 0.0) + (
+                time.perf_counter() - start
+            )
+
+    def total(self, *names: str) -> float:
+        if not names:
+            return sum(self.seconds.values())
+        return sum(self.seconds.get(n, 0.0) for n in names)

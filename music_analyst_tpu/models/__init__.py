@@ -1,0 +1,1 @@
+"""Model families: keyword mock, encoder classifier, decoder LM."""

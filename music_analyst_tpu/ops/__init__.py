@@ -1,0 +1,6 @@
+"""Device-side compute kernels (JAX/XLA, with Pallas variants for hot ops)."""
+
+from music_analyst_tpu.ops.histogram import (
+    sharded_histogram,
+    token_histogram,
+)

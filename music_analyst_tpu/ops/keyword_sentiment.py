@@ -1,0 +1,149 @@
+"""Vectorized keyword-sentiment kernel — the ``--mock`` backend on device.
+
+The reference's mock classifier scans each lyric for five positive and five
+negative substrings and labels by the sign of the score
+(``scripts/sentiment_classifier.py:66-83``).  Here the scan is a batched
+device kernel: lyrics are encoded as a padded uint8 byte matrix, ASCII
+lowercasing and all ten substring matches run as fused elementwise/compare
+ops over the whole batch — thousands of songs per dispatch instead of one
+Python loop iteration per song.
+
+Semantics notes (SURVEY.md §5 contract #5):
+
+* matching is *substring containment*, not word-boundary ("lovely" scores
+  as "love" — faithfully reproduced);
+* score = (#positive keywords present) − (#negative present), each keyword
+  counted once regardless of repeats; label = sign of score;
+* lowercasing here is ASCII (A-Z); the reference uses Python ``str.lower``.
+  The only divergence is exotic Unicode that lowercases *into* ASCII
+  (e.g. ``İ`` → ``i̇``, Kelvin ``K`` → ``k``) — impossible to hit with the
+  ASCII-only keyword set unless the uppercase variant splits a keyword,
+  which cannot create a new ASCII keyword substring match.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Reference keyword sets (scripts/sentiment_classifier.py:70-71).
+POSITIVE_KEYWORDS: Tuple[str, ...] = ("love", "happy", "joy", "sunshine", "smile")
+NEGATIVE_KEYWORDS: Tuple[str, ...] = ("cry", "sad", "pain", "lonely", "tears")
+
+MAX_KEYWORD_LEN = max(map(len, POSITIVE_KEYWORDS + NEGATIVE_KEYWORDS))
+
+# Label ids follow utils.labels.LABEL_TO_ID: 0=Positive, 1=Neutral, 2=Negative.
+_POSITIVE, _NEUTRAL, _NEGATIVE = 0, 1, 2
+
+
+def _lower_ascii(x: jax.Array) -> jax.Array:
+    return jnp.where((x >= 65) & (x <= 90), x + 32, x)
+
+
+def _contains(x: jax.Array, keyword: np.ndarray) -> jax.Array:
+    """Per-row substring containment of ``keyword`` in byte matrix ``x``.
+
+    Shifted-compare formulation: for an m-byte keyword, AND together m
+    shifted equality masks and OR-reduce over positions.  XLA fuses the
+    whole thing into one pass over the batch; padding bytes (0) can never
+    match because keywords contain no NUL.
+    """
+    length = x.shape[-1]
+    m = int(keyword.shape[0])
+    if length < m:
+        return jnp.zeros(x.shape[:-1], dtype=bool)
+    window = length - m + 1
+    acc = x[..., 0:window] == keyword[0]
+    for j in range(1, m):
+        acc = acc & (x[..., j : window + j] == keyword[j])
+    return jnp.any(acc, axis=-1)
+
+
+@jax.jit
+def keyword_scores(byte_matrix: jax.Array) -> jax.Array:
+    """Scores for a padded uint8 batch ``[B, L]`` → int32 ``[B]``."""
+    x = _lower_ascii(byte_matrix)
+    score = jnp.zeros(x.shape[:-1], dtype=jnp.int32)
+    for kw in POSITIVE_KEYWORDS:
+        score = score + _contains(x, np.frombuffer(kw.encode(), dtype=np.uint8)).astype(
+            jnp.int32
+        )
+    for kw in NEGATIVE_KEYWORDS:
+        score = score - _contains(x, np.frombuffer(kw.encode(), dtype=np.uint8)).astype(
+            jnp.int32
+        )
+    return score
+
+
+@jax.jit
+def keyword_labels(byte_matrix: jax.Array) -> jax.Array:
+    """Label ids (0=Positive, 1=Neutral, 2=Negative) for a padded batch."""
+    score = keyword_scores(byte_matrix)
+    return jnp.where(score > 0, _POSITIVE, jnp.where(score < 0, _NEGATIVE, _NEUTRAL))
+
+
+def encode_batch(
+    texts: Sequence[str],
+    length: int,
+) -> Tuple[np.ndarray, List[int]]:
+    """Encode stripped lyrics to a padded ``[B, length]`` uint8 matrix.
+
+    Returns the matrix plus the indices of songs whose UTF-8 encoding
+    exceeds ``length`` (their windows need the chunked path to preserve
+    exact containment semantics).
+    """
+    batch = np.zeros((len(texts), length), dtype=np.uint8)
+    overflow: List[int] = []
+    for i, text in enumerate(texts):
+        data = text.strip().encode("utf-8", errors="replace")
+        if len(data) > length:
+            overflow.append(i)
+            data = data[:length]
+        row = np.frombuffer(data, dtype=np.uint8)
+        batch[i, : row.shape[0]] = row
+    return batch, overflow
+
+
+def score_texts(
+    texts: Sequence[str],
+    length: int = 4096,
+) -> np.ndarray:
+    """Exact batched scores for arbitrary-length lyrics.
+
+    Songs fitting in ``length`` bytes go through the dense kernel in one
+    batch; longer songs are re-scored over overlapping windows (overlap
+    ``MAX_KEYWORD_LEN - 1`` so no match can straddle a boundary), OR-ing
+    per-window containment via per-keyword score decomposition.
+    """
+    batch, overflow = encode_batch(texts, length)
+    scores = np.array(keyword_scores(batch))
+    for i in overflow:
+        scores[i] = _score_long_text(texts[i].strip(), length)
+    return scores
+
+
+def _score_long_text(text: str, length: int) -> int:
+    """Windowed exact scoring for a single oversized lyric."""
+    data = text.encode("utf-8", errors="replace")
+    step = length - (MAX_KEYWORD_LEN - 1)
+    windows = [data[start : start + length] for start in range(0, len(data), step)]
+    batch = np.zeros((len(windows), length), dtype=np.uint8)
+    for i, w in enumerate(windows):
+        batch[i, : len(w)] = np.frombuffer(w, dtype=np.uint8)
+    x = _lower_ascii(jnp.asarray(batch))
+    score = 0
+    for kw in POSITIVE_KEYWORDS:
+        hit = bool(
+            np.asarray(_contains(x, np.frombuffer(kw.encode(), dtype=np.uint8))).any()
+        )
+        score += int(hit)
+    for kw in NEGATIVE_KEYWORDS:
+        hit = bool(
+            np.asarray(_contains(x, np.frombuffer(kw.encode(), dtype=np.uint8))).any()
+        )
+        score -= int(hit)
+    return score

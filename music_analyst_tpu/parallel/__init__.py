@@ -1,0 +1,16 @@
+"""Mesh construction, sharding rules, and collectives.
+
+This package is the TPU-native replacement for the reference's MPI backend
+(SURVEY.md §2.4): where the reference uses ``MPI_Init/Bcast/Barrier/Reduce``
+and a string-keyed ``Send/Recv`` shuffle over MPICH, this layer builds a
+``jax.sharding.Mesh`` over the available chips and lets XLA insert ICI/DCN
+collectives (``psum``/``pmax``/``pmin``/``ppermute``) from sharding
+annotations.
+"""
+
+from music_analyst_tpu.parallel.mesh import (
+    MeshSpec,
+    build_mesh,
+    data_parallel_mesh,
+    factor_devices,
+)

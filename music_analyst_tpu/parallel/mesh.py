@@ -1,0 +1,134 @@
+"""Device-mesh construction for single-host and multi-host runs.
+
+The reference's world model is ``mpirun -np N`` over homogeneous ranks with
+rank 0 as master (``src/parallel_spotify.c:725-730``).  Here the analogue is
+a named ``jax.sharding.Mesh`` whose axes carry semantic names:
+
+* ``dp`` — data parallel (batch / corpus shards; the reference's byte-range
+  partitioning axis),
+* ``tp`` — tensor parallel (model weight shards; no reference analogue —
+  needed for the large-LM sentiment config),
+* ``sp`` — sequence/context parallel (ring attention over long sequences),
+* ``ep`` — expert parallel (MoE layers; optional, folds into ``tp``
+  by default),
+* ``pp`` — pipeline parallel (layer stages; optional).
+
+Axis layout convention: ``dp`` is the outermost (slowest-varying, may ride
+DCN across hosts); ``tp``/``sp`` are innermost so their collectives ride ICI
+(scaling-book recipe: keep the chatty axes on the fastest interconnect).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """A named axis→size assignment; product must equal the device count."""
+
+    axes: Tuple[Tuple[str, int], ...]
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(name for name, _ in self.axes)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(size for _, size in self.axes)
+
+    def size(self) -> int:
+        return math.prod(self.shape)
+
+
+def factor_devices(
+    n_devices: int,
+    axis_names: Sequence[str] = ("dp", "tp", "sp"),
+    fixed: Optional[Dict[str, int]] = None,
+) -> MeshSpec:
+    """Factor ``n_devices`` across named axes, largest factors first.
+
+    Greedy: honor ``fixed`` sizes first, then peel the largest power-of-two
+    (or remaining prime) factors onto the remaining axes left-to-right, so
+    the first axis (usually ``dp``) gets the most devices.  Always returns a
+    spec whose product is exactly ``n_devices``.
+    """
+    fixed = dict(fixed or {})
+    remaining = n_devices
+    for name, size in fixed.items():
+        if remaining % size != 0:
+            raise ValueError(
+                f"fixed axis {name}={size} does not divide {remaining}"
+            )
+        remaining //= size
+    free_axes = [a for a in axis_names if a not in fixed]
+    # Split the remaining device count into len(free_axes) near-even
+    # divisor factors, then hand the largest factor to the earliest free
+    # axis (dp first) so the batch axis carries the most devices.
+    factors: List[int] = []
+    for i in range(len(free_axes)):
+        slots_left = len(free_axes) - i
+        if slots_left == 1:
+            factors.append(remaining)
+            remaining = 1
+            break
+        target = max(1, round(remaining ** (1.0 / slots_left)))
+        best = 1
+        for cand in range(target, 0, -1):
+            if remaining % cand == 0:
+                best = cand
+                break
+        for cand in range(target + 1, remaining + 1):
+            if remaining % cand == 0:
+                if abs(cand - target) < abs(best - target):
+                    best = cand
+                break
+        factors.append(best)
+        remaining //= best
+    sizes: Dict[str, int] = dict(fixed)
+    for name, factor in zip(free_axes, sorted(factors, reverse=True)):
+        sizes[name] = factor
+    return MeshSpec(tuple((name, sizes[name]) for name in axis_names))
+
+
+def build_mesh(
+    spec: MeshSpec | None = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_names: Sequence[str] = ("dp",),
+) -> Mesh:
+    """Build a mesh from a spec (or a 1-D mesh over all devices)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if spec is None:
+        spec = MeshSpec(((axis_names[0], len(devs)),) if len(axis_names) == 1
+                        else tuple(factor_devices(len(devs), axis_names).axes))
+    if spec.size() != len(devs):
+        raise ValueError(
+            f"mesh spec {spec.axes} needs {spec.size()} devices, have {len(devs)}"
+        )
+    mesh_devices = np.asarray(devs).reshape(spec.shape)
+    return Mesh(mesh_devices, spec.names)
+
+
+def data_parallel_mesh(
+    n_devices: Optional[int] = None, axis: str = "dp"
+) -> Mesh:
+    """1-D data-parallel mesh — the reference's only parallelism strategy."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return build_mesh(MeshSpec(((axis, len(devs)),)), devices=devs)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """Shard the leading (batch) dimension over ``axis``."""
+    return NamedSharding(mesh, PartitionSpec(axis))

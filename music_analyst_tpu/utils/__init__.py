@@ -1,0 +1,3 @@
+"""Shared utilities: label contract, config defaults, small helpers."""
+
+from music_analyst_tpu.utils.labels import SUPPORTED_LABELS, normalise_label

@@ -1,0 +1,37 @@
+"""Test harness: emulate an 8-device TPU mesh on CPU.
+
+The JAX-native analogue of the reference's "mpirun -np N on one box"
+verification strategy (SURVEY.md §4): force 8 virtual CPU devices so every
+sharding/collective test exercises a real multi-device mesh without TPU
+hardware.  Must run before the first ``import jax`` anywhere in the test
+process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# A site hook may have imported jax and registered a hardware backend before
+# this conftest runs; as long as no backend client is initialized yet, the
+# platform can still be forced to CPU via the config API.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", "tests require the CPU-emulated mesh"
+assert len(jax.devices()) == 8
+
+import pathlib
+
+import pytest
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+
+@pytest.fixture(scope="session")
+def fixture_csv() -> pathlib.Path:
+    return FIXTURES / "mini_songs.csv"

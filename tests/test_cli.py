@@ -1,0 +1,70 @@
+"""CLI surface: flag compatibility and artifact wiring."""
+
+import json
+
+from music_analyst_tpu.cli.main import main
+
+
+def test_analyze_command(fixture_csv, tmp_path, capsys):
+    rc = main(
+        [
+            "analyze",
+            str(fixture_csv),
+            "--output-dir",
+            str(tmp_path),
+            "--word-limit",
+            "5",
+            "--ingest",
+            "python",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "=== Parallel Spotify Analysis ===" in out
+    assert "Total songs processed:" in out
+    assert (tmp_path / "word_counts.csv").exists()
+    assert (tmp_path / "top_artists.csv").exists()
+    assert (tmp_path / "performance_metrics.json").exists()
+
+
+def test_sentiment_command_mock(fixture_csv, tmp_path, capsys):
+    rc = main(
+        [
+            "sentiment",
+            str(fixture_csv),
+            "--mock",
+            "--output-dir",
+            str(tmp_path),
+            "--limit",
+            "3",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Sentiment summary:" in out
+    totals = json.loads((tmp_path / "sentiment_totals.json").read_text())
+    assert sum(totals.values()) == 3
+
+
+def test_split_command(fixture_csv, tmp_path, capsys):
+    rc = main(
+        ["split", str(fixture_csv), "--output-dir", str(tmp_path / "cols")]
+    )
+    assert rc == 0
+    assert (tmp_path / "cols" / "artist.csv").exists()
+
+
+def test_wordcount_per_song_command(fixture_csv, tmp_path):
+    rc = main(
+        [
+            "wordcount-per-song",
+            str(fixture_csv),
+            "--output-dir",
+            str(tmp_path),
+            "--workers",
+            "2",
+        ]
+    )
+    assert rc == 0
+    assert (tmp_path / "word_counts_global.csv").exists()
+    assert (tmp_path / "word_counts_by_song.csv").exists()

@@ -1,0 +1,115 @@
+"""Exact CSV record/field semantics and count-table export contract."""
+
+import pytest
+
+from music_analyst_tpu.data.csv_io import (
+    clean_field,
+    format_count_row,
+    iter_csv_records_exact,
+    iter_dataset_exact,
+    iter_songs,
+    parse_record_exact,
+    sort_count_entries,
+    write_count_csv,
+)
+
+
+class TestRecordReader:
+    def test_simple_lines(self):
+        recs = list(iter_csv_records_exact(b"a,b\nc,d\n"))
+        assert recs == [b"a,b\n", b"c,d\n"]
+
+    def test_quoted_newline_stays_in_record(self):
+        data = b'x,"line1\nline2",y\nnext,row\n'
+        recs = list(iter_csv_records_exact(data))
+        assert recs == [b'x,"line1\nline2",y\n', b"next,row\n"]
+
+    def test_escaped_quotes_do_not_close_field(self):
+        data = b'a,"he said ""hi""\nmore",z\nb\n'
+        recs = list(iter_csv_records_exact(data))
+        assert len(recs) == 2
+        assert recs[0].endswith(b",z\n")
+
+    def test_crlf_and_bare_cr(self):
+        recs = list(iter_csv_records_exact(b"a\r\nb\rc\n"))
+        assert recs == [b"a\r\n", b"b\r", b"c\n"]
+
+    def test_no_trailing_newline(self):
+        assert list(iter_csv_records_exact(b"a,b")) == [b"a,b"]
+
+
+class TestFieldCleaning:
+    def test_unquote_and_unescape(self):
+        assert clean_field(b'  "say ""hi"" now"  ') == b'say "hi" now'
+
+    def test_preserve_outer_quotes(self):
+        raw = b'"keep ""this"" quoted"'
+        assert clean_field(raw, preserve_outer_quotes=True) == raw
+
+    def test_unquoted_trimmed(self):
+        assert clean_field(b"  plain \t") == b"plain"
+
+    def test_lone_quote_not_treated_as_quoted(self):
+        assert clean_field(b'"') == b'"'
+
+
+class TestParseRecord:
+    def test_text_is_everything_after_third_comma(self):
+        rec = b"artist,song,link,one, two, three\n"
+        artist, text = parse_record_exact(rec)
+        assert artist == b"artist"
+        assert text == b"one, two, three"
+
+    def test_quoted_commas_do_not_split(self):
+        rec = b'"Earth, Wind & Fire",September,/l,body text\n'
+        artist, text = parse_record_exact(rec)
+        assert artist == b"Earth, Wind & Fire"
+        assert text == b"body text"
+
+    def test_too_few_fields_rejected(self):
+        assert parse_record_exact(b"only,two\n") is None
+
+    def test_dataset_iteration_skips_header_and_bad_rows(self, fixture_csv):
+        data = fixture_csv.read_bytes()
+        rows = list(iter_dataset_exact(data))
+        artists = [a.decode() for a, _ in rows]
+        assert "BadRow" not in artists
+        assert artists[0] == "ABBA"
+        assert "Earth, Wind & Fire" in artists
+        # Empty-artist row is still yielded (counts toward song total).
+        assert "" in artists
+
+
+class TestDictReaderPath:
+    def test_iter_songs_limit_and_columns(self, fixture_csv):
+        rows = list(iter_songs(str(fixture_csv), limit=2))
+        assert len(rows) == 2
+        artist, song, text = rows[0]
+        assert artist == "ABBA"
+        assert song == "Ahe's My Kind Of Girl"
+        assert "wonderful face" in text
+
+
+class TestCountExport:
+    def test_sort_count_desc_tie_bytewise(self):
+        entries = [("beta", 2), ("alpha", 2), ("zed", 5), ("Ab", 2)]
+        # strcmp order: 'A' (0x41) < 'a' (0x61)
+        assert sort_count_entries(entries) == [
+            ("zed", 5),
+            ("Ab", 2),
+            ("alpha", 2),
+            ("beta", 2),
+        ]
+
+    def test_quote_doubling(self):
+        assert format_count_row('say "hi"', 3) == '"say ""hi""",3\n'
+
+    def test_write_count_csv_limit_and_header(self, tmp_path):
+        path = tmp_path / "word_counts.csv"
+        write_count_csv(str(path), "word", [("b", 1), ("a", 3), ("c", 2)], limit=2)
+        assert path.read_text() == 'word,count\n"a",3\n"c",2\n'
+
+    def test_zero_limit_means_unlimited(self, tmp_path):
+        path = tmp_path / "t.csv"
+        write_count_csv(str(path), "artist", [("x", 1), ("y", 1)], limit=0)
+        assert path.read_text().count("\n") == 3

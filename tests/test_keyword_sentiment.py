@@ -1,0 +1,97 @@
+"""Device keyword kernel vs the reference heuristic, exactly re-stated."""
+
+import numpy as np
+import pytest
+
+from music_analyst_tpu.ops.keyword_sentiment import (
+    MAX_KEYWORD_LEN,
+    NEGATIVE_KEYWORDS,
+    POSITIVE_KEYWORDS,
+    encode_batch,
+    keyword_labels,
+    keyword_scores,
+    score_texts,
+)
+from music_analyst_tpu.utils.labels import score_to_label
+
+
+def reference_mock_classify(lyrics: str) -> str:
+    """Verbatim restatement of scripts/sentiment_classifier.py:57-83."""
+    lyrics = lyrics.strip()
+    if not lyrics:
+        return "Neutral"
+    lowered = lyrics.lower()
+    score = 0
+    for word in POSITIVE_KEYWORDS:
+        if word in lowered:
+            score += 1
+    for word in NEGATIVE_KEYWORDS:
+        if word in lowered:
+            score -= 1
+    if score > 0:
+        return "Positive"
+    if score < 0:
+        return "Negative"
+    return "Neutral"
+
+
+CASES = [
+    "I love sunshine and smiles",           # positive
+    "cry me a river of tears",              # negative
+    "LOVE and PAIN in equal measure",       # balanced -> neutral
+    "nothing to see here",                  # no keywords
+    "",                                     # empty
+    "   \t  ",                              # whitespace only
+    "lovely day",                           # substring containment: 'love'
+    "crying sadness",                       # 'cry' + 'sad'
+    "sunshine sunshine sunshine",           # repeats count once
+    "hap py jo y",                          # split keywords don't match
+    "Smile! though your heart is aching",   # punctuation adjacent
+]
+
+
+def test_kernel_matches_reference_on_cases():
+    got = [score_to_label(int(s)) for s in score_texts(CASES)]
+    want = [reference_mock_classify(t) for t in CASES]
+    assert got == want
+
+
+def test_kernel_matches_reference_randomized():
+    rng = np.random.default_rng(42)
+    words = list(POSITIVE_KEYWORDS + NEGATIVE_KEYWORDS) + [
+        "the", "music", "night", "dance", "street", "heart", "fire",
+    ]
+    texts = [
+        " ".join(rng.choice(words, size=rng.integers(0, 40)))
+        for _ in range(300)
+    ]
+    got = [score_to_label(int(s)) for s in score_texts(texts)]
+    want = [reference_mock_classify(t) for t in texts]
+    assert got == want
+
+
+def test_long_lyric_chunked_path_exact():
+    # Keyword placed beyond the dense window and straddling a window edge.
+    filler = "na " * 3000  # ~9000 bytes > 4096 window
+    text = filler + "sunshine"
+    assert score_to_label(int(score_texts([text], length=4096)[0])) == "Positive"
+    # keyword exactly straddles the first window boundary
+    pad = "x" * (4096 - 4)
+    straddle = pad + "tears"
+    assert (
+        score_to_label(int(score_texts([straddle], length=4096)[0]))
+        == reference_mock_classify(straddle)
+    )
+
+
+def test_label_ids_device_path():
+    batch, overflow = encode_batch(["love", "tears", "meh"], 64)
+    assert overflow == []
+    labels = np.asarray(keyword_labels(batch))
+    np.testing.assert_array_equal(labels, [0, 2, 1])
+
+
+def test_uppercase_handled_on_device():
+    batch, _ = encode_batch(["LOVE IS ALL", "TEARS FALL"], 64)
+    scores = np.asarray(keyword_scores(batch))
+    assert scores[0] == 1 and scores[1] == -1

@@ -1,0 +1,26 @@
+"""Label contract shared across classifier backends."""
+
+from music_analyst_tpu.utils.labels import normalise_label, score_to_label
+
+
+def test_first_token_title_cased():
+    assert normalise_label("positive") == "Positive"
+    assert normalise_label("NEGATIVE obviously") == "Negative"
+    assert normalise_label("neutral.") == "Neutral"  # 'Neutral.' not in set
+
+
+def test_unknown_maps_to_neutral():
+    assert normalise_label("happy") == "Neutral"
+
+
+def test_empty_output_fixed_to_neutral():
+    # The reference crashes here (scripts/sentiment_classifier.py:105,
+    # ''.split()[0] -> IndexError); we normalize to Neutral instead.
+    assert normalise_label("") == "Neutral"
+    assert normalise_label("   ") == "Neutral"
+
+
+def test_score_sign():
+    assert score_to_label(2) == "Positive"
+    assert score_to_label(-1) == "Negative"
+    assert score_to_label(0) == "Neutral"

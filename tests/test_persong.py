@@ -1,0 +1,54 @@
+"""Per-song tool parity: artifacts, tie-order, row skipping."""
+
+import csv
+from collections import Counter
+
+from music_analyst_tpu.data.tokenizer import tokenize_latin1
+from music_analyst_tpu.engines.persong import (
+    detect_delimiter,
+    process_row,
+    resolve_workers,
+    run_per_song_wordcount,
+)
+
+
+def test_detect_delimiter_fallback():
+    assert detect_delimiter("a;b;c\n1;2;3\n") == ";"
+    # empty sample raises csv.Error inside Sniffer -> fallback comma
+    assert detect_delimiter("") == ","
+
+
+def test_resolve_workers():
+    assert resolve_workers(4) == 4
+    assert resolve_workers(0) >= 1
+
+
+def test_process_row_empty_tokens_none():
+    assert process_row({"artist": "A", "song": "S", "text": "a b c"}) is None
+    got = process_row({"artist": " A ", "song": "S", "text": "hello hello world"})
+    assert got == ("A", "S", Counter({"hello": 2, "world": 1}))
+
+
+def test_end_to_end(fixture_csv, tmp_path):
+    global_path, per_song_path, rows = run_per_song_wordcount(
+        str(fixture_csv), output_dir=str(tmp_path), quiet=True
+    )
+    # oracle over the same DictReader rows
+    oracle = Counter()
+    with open(fixture_csv, newline="", encoding="utf-8-sig") as fh:
+        for row in csv.DictReader(fh):
+            oracle.update(tokenize_latin1(row.get("text") or ""))
+
+    with open(global_path, newline="") as fh:
+        reader = csv.reader(fh)
+        assert next(reader) == ["word", "count"]
+        got = [(w, int(c)) for w, c in reader]
+    # most_common() order: count desc, ties by first-seen insertion
+    assert got == oracle.most_common()
+
+    with open(per_song_path, newline="") as fh:
+        reader = csv.reader(fh)
+        assert next(reader) == ["artist", "song", "word", "count"]
+        by_song = list(reader)
+    total_from_rows = sum(int(c) for _, _, _, c in by_song)
+    assert total_from_rows == sum(oracle.values())

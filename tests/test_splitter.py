@@ -1,0 +1,81 @@
+"""Column splitters: dataset preprocessor + generic tool."""
+
+from music_analyst_tpu.data.splitter import (
+    read_header_labels,
+    sanitize_filename,
+    sanitize_header_name,
+    split_csv_columns,
+    split_dataset_columns,
+)
+
+
+class TestSanitizers:
+    def test_header_name_c_semantics(self):
+        assert sanitize_header_name("artist") == "artist"
+        assert sanitize_header_name("my col!") == "my_col_"
+        assert sanitize_header_name("") == "col"
+        # multi-byte char -> one underscore per byte (C byte loop)
+        assert sanitize_header_name("é") == "__"
+        assert sanitize_header_name("a\r\nb") == "ab"
+
+    def test_filename_python_semantics(self):
+        assert sanitize_filename("My Col!") == "My_Col_"
+        assert sanitize_filename("") == "col"
+        # \w is Unicode in the generic tool: accents survive
+        assert sanitize_filename("é") == "é"
+
+
+class TestDatasetSplitter:
+    def test_split_preserves_quoting(self, fixture_csv, tmp_path):
+        artist_label, text_label = read_header_labels(str(fixture_csv))
+        assert (artist_label, text_label) == ("artist", "text")
+        artist_path, text_path = split_dataset_columns(
+            str(fixture_csv),
+            str(tmp_path / "split_columns"),
+            sanitize_header_name(artist_label),
+            sanitize_header_name(text_label),
+            artist_label,
+            text_label,
+        )
+        artist_lines = open(artist_path, "rb").read().split(b"\n")
+        assert artist_lines[0] == b"artist"
+        assert artist_lines[1] == b"ABBA"
+        # Quoted artist stays quoted verbatim
+        assert b'"Earth, Wind & Fire"' in artist_lines
+        text_data = open(text_path, "rb").read()
+        # Outer quotes + escaped quotes preserved, embedded newline preserved
+        assert b'""summer evening""' in text_data
+        assert b"wonderful face  \nAnd it means" in text_data
+
+    def test_bad_rows_skipped(self, fixture_csv, tmp_path):
+        artist_path, _ = split_dataset_columns(
+            str(fixture_csv), str(tmp_path), "artist", "text", "artist", "text"
+        )
+        content = open(artist_path, "rb").read()
+        assert b"BadRow" not in content
+
+
+class TestGenericSplitter:
+    def test_one_file_per_column(self, fixture_csv, tmp_path):
+        out_dir, names = split_csv_columns(
+            str(fixture_csv), output_dir=str(tmp_path / "cols")
+        )
+        assert names == ["artist.csv", "song.csv", "link.csv", "text.csv"]
+        artist_rows = (out_dir / "artist.csv").read_text(encoding="utf-8-sig")
+        assert artist_rows.splitlines()[0] == "artist"
+        assert "Beyoncé" in artist_rows
+
+    def test_collision_suffixes(self, tmp_path):
+        src = tmp_path / "dup.csv"
+        src.write_text("a,a,b\n1,2,3\n", encoding="utf-8")
+        out_dir, names = split_csv_columns(str(src), output_dir=str(tmp_path / "o"))
+        assert names == ["a.csv", "a_2.csv", "b.csv"]
+
+    def test_no_header_mode(self, tmp_path):
+        src = tmp_path / "nh.csv"
+        src.write_text("1,2\n3,4\n", encoding="utf-8")
+        out_dir, names = split_csv_columns(
+            str(src), output_dir=str(tmp_path / "o2"), no_header=True
+        )
+        assert names == ["col1.csv", "col2.csv"]
+        assert (out_dir / "col1.csv").read_text(encoding="utf-8-sig") == "1\n3\n"

@@ -1,0 +1,63 @@
+"""Tokenizer parity with both reference tokenizers (SURVEY.md §5 contract #1)."""
+
+from music_analyst_tpu.data.tokenizer import tokenize_ascii, tokenize_latin1
+
+
+class TestAsciiTokenizer:
+    """C-binary semantics: src/parallel_spotify.c:350-394."""
+
+    def test_basic_lowercase_and_min_length(self):
+        assert tokenize_ascii("Look at her FACE it") == ["look", "her", "face"]
+
+    def test_apostrophes_preserved_and_counted(self):
+        assert tokenize_ascii("it's don't I'm") == ["it's", "don't", "i'm"]
+
+    def test_all_apostrophe_token_is_counted(self):
+        # The C tokenizer counts ''' (3 bytes of token chars) as a word.
+        assert tokenize_ascii("x ''' y") == ["'''"]
+
+    def test_short_tokens_dropped(self):
+        assert tokenize_ascii("a an the it") == ["the"]
+
+    def test_non_ascii_bytes_break_tokens(self):
+        # 'café' = b'caf\xc3\xa9' — the UTF-8 bytes are separators, leaving
+        # 'caf' (>=3); the fragments of 'naïve' ('na', 've') are < 3 bytes
+        # and are dropped.
+        assert tokenize_ascii("café naïve") == ["caf"]
+        # 'naïveté' -> fragments 'na' (dropped), 'vet' (kept)
+        assert tokenize_ascii("naïveté café") == ["vet", "caf"]
+
+    def test_digits_are_token_chars(self):
+        assert tokenize_ascii("route 66 abc123") == ["route", "abc123"]
+
+    def test_punctuation_separates(self):
+        assert tokenize_ascii("hi-de-hi! (ho)") == []
+        assert tokenize_ascii("one,two;three") == ["one", "two", "three"]
+
+    def test_bytes_input(self):
+        assert tokenize_ascii(b"Hello WORLD") == ["hello", "world"]
+
+    def test_trailing_token_flushed(self):
+        assert tokenize_ascii("ends with word") == ["ends", "with", "word"]
+
+
+class TestLatin1Tokenizer:
+    """Serial-tool semantics: scripts/word_count_per_song.py:27-39."""
+
+    def test_accented_chars_are_token_chars(self):
+        assert list(tokenize_latin1("café naïve")) == ["café", "naïve"]
+
+    def test_all_apostrophe_rejected(self):
+        assert list(tokenize_latin1("x ''' y")) == []
+
+    def test_min_length_in_characters(self):
+        # 'été' is 3 characters (but 5 UTF-8 bytes) — counted here.
+        assert list(tokenize_latin1("été ok")) == ["été"]
+
+    def test_lowercasing_is_unicode(self):
+        assert list(tokenize_latin1("CAFÉ")) == ["café"]
+
+    def test_divergence_from_ascii_path(self):
+        text = "café"
+        assert list(tokenize_latin1(text)) == ["café"]
+        assert tokenize_ascii(text) == ["caf"]

@@ -1,0 +1,30 @@
+"""Vocabulary + corpus encoding (host side of the dense-histogram design)."""
+
+import numpy as np
+
+from music_analyst_tpu.data.vocab import Vocab, encode_corpus
+
+
+def test_insertion_order_ids():
+    v = Vocab()
+    assert v.add("love") == 0
+    assert v.add("pain") == 1
+    assert v.add("love") == 0
+    assert len(v) == 2
+    assert v.tokens == ["love", "pain"]
+    assert v.get("missing") == -1
+
+
+def test_encode_corpus_offsets():
+    vocab, ids, offsets = encode_corpus([["a", "b", "a"], [], ["b", "c"]])
+    assert ids.dtype == np.int32
+    assert offsets.dtype == np.int64
+    np.testing.assert_array_equal(ids, [0, 1, 0, 1, 2])
+    np.testing.assert_array_equal(offsets, [0, 3, 3, 5])
+    assert vocab.tokens == ["a", "b", "c"]
+
+
+def test_counts_to_entries_drops_zeros():
+    v = Vocab(["x", "y", "z"])
+    entries = v.counts_to_entries(np.array([2, 0, 7]))
+    assert entries == [("x", 2), ("z", 7)]

@@ -1,0 +1,100 @@
+"""End-to-end analysis parity on the fixture corpus (golden oracle)."""
+
+import json
+from collections import Counter
+
+import pytest
+
+from music_analyst_tpu.data.csv_io import iter_dataset_exact, sort_count_entries
+from music_analyst_tpu.data.tokenizer import tokenize_ascii
+from music_analyst_tpu.engines.wordcount import run_analysis
+
+
+def oracle_counts(data: bytes):
+    """Serial restatement of the reference's counting semantics."""
+    words = Counter()
+    artists = Counter()
+    songs = 0
+    word_total = 0
+    for artist_raw, text_raw in iter_dataset_exact(data):
+        toks = tokenize_ascii(text_raw)
+        words.update(toks)
+        word_total += len(toks)
+        songs += 1  # every record counts, even empty artist (contract #3)
+        if artist_raw:
+            artists[artist_raw.decode("utf-8", errors="replace")] += 1
+    return words, artists, songs, word_total
+
+
+@pytest.fixture(scope="module")
+def analysis(fixture_csv_module, tmp_path_factory):
+    out = tmp_path_factory.mktemp("analysis_out")
+    return (
+        run_analysis(str(fixture_csv_module), output_dir=str(out), quiet=True),
+        out,
+        fixture_csv_module.read_bytes(),
+    )
+
+
+@pytest.fixture(scope="module")
+def fixture_csv_module():
+    import pathlib
+
+    return pathlib.Path(__file__).parent / "fixtures" / "mini_songs.csv"
+
+
+def test_counts_match_oracle(analysis):
+    result, _, data = analysis
+    words, artists, songs, word_total = oracle_counts(data)
+    assert result.total_songs == songs
+    assert result.total_words == word_total
+    assert result.word_entries == sort_count_entries(words.items())
+    assert result.artist_entries == sort_count_entries(artists.items())
+
+
+def test_output_files_exact_format(analysis):
+    result, out, data = analysis
+    words, artists, _, _ = oracle_counts(data)
+    word_csv = (out / "word_counts.csv").read_text()
+    lines = word_csv.splitlines()
+    assert lines[0] == "word,count"
+    top_word, top_count = sort_count_entries(words.items())[0]
+    assert lines[1] == f'"{top_word}",{top_count}'
+    artist_csv = (out / "top_artists.csv").read_text()
+    assert artist_csv.splitlines()[0] == "artist,count"
+    # Quoted-comma artist must round-trip with quote doubling rules
+    assert '"Earth, Wind & Fire",1' in artist_csv
+
+
+def test_metrics_schema(analysis):
+    _, out, _ = analysis
+    metrics = json.loads((out / "performance_metrics.json").read_text())
+    assert metrics["processes"] == 8
+    for key in ("total_songs", "total_words", "compute_time", "total_time"):
+        assert key in metrics
+    for sub in ("avg_seconds", "min_seconds", "max_seconds"):
+        assert sub in metrics["compute_time"]
+        assert sub in metrics["total_time"]
+    assert len(metrics["per_chip"]) == 8
+    assert metrics["device_platform"] == "cpu"
+
+
+def test_split_artifacts_written(analysis):
+    _, out, _ = analysis
+    split = out / "split_columns"
+    assert (split / "artist.csv").exists()
+    assert (split / "text.csv").exists()
+
+
+def test_word_limit_truncates(fixture_csv_module, tmp_path):
+    result = run_analysis(
+        str(fixture_csv_module),
+        output_dir=str(tmp_path),
+        word_limit=3,
+        artist_limit=2,
+        quiet=True,
+    )
+    word_lines = (tmp_path / "word_counts.csv").read_text().splitlines()
+    assert len(word_lines) == 4  # header + 3
+    artist_lines = (tmp_path / "top_artists.csv").read_text().splitlines()
+    assert len(artist_lines) == 3

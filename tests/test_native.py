@@ -1,0 +1,118 @@
+"""Native C++ ingest vs the pure-Python oracle: byte-exact parity."""
+
+import csv
+
+import numpy as np
+import pytest
+
+from music_analyst_tpu.data import native
+from music_analyst_tpu.data.ingest import ingest_python
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native lib unavailable: {native.unavailable_reason() if not native.available() else ''}",
+)
+
+
+def word_counts(res):
+    hist = np.bincount(
+        res.word_ids[res.word_ids >= 0], minlength=len(res.word_vocab)
+    )
+    return {
+        res.word_vocab.tokens[i]: int(n) for i, n in enumerate(hist) if n
+    }
+
+
+def artist_counts(res):
+    from collections import Counter
+
+    return Counter(
+        res.artist_vocab.tokens[i] for i in res.artist_ids if i >= 0
+    )
+
+
+def assert_parity(native_res, python_res):
+    assert native_res.song_count == python_res.song_count
+    assert native_res.token_count == python_res.token_count
+    np.testing.assert_array_equal(
+        native_res.word_offsets, python_res.word_offsets
+    )
+    assert word_counts(native_res) == word_counts(python_res)
+    assert artist_counts(native_res) == artist_counts(python_res)
+    # token *streams* must match too (same tokens in the same positions),
+    # not just the histograms
+    native_tokens = [
+        native_res.word_vocab.tokens[i] for i in native_res.word_ids
+    ]
+    python_tokens = [
+        python_res.word_vocab.tokens[i] for i in python_res.word_ids
+    ]
+    assert native_tokens == python_tokens
+    native_artists = [
+        native_res.artist_vocab.tokens[i] if i >= 0 else None
+        for i in native_res.artist_ids
+    ]
+    python_artists = [
+        python_res.artist_vocab.tokens[i] if i >= 0 else None
+        for i in python_res.artist_ids
+    ]
+    assert native_artists == python_artists
+
+
+def test_fixture_parity(fixture_csv):
+    n = native.ingest_native(str(fixture_csv))
+    p = ingest_python(fixture_csv.read_bytes())
+    assert_parity(n, p)
+
+
+def test_randomized_adversarial_parity(tmp_path):
+    """Quoted commas, embedded newlines, `""` escapes, accents, empties."""
+    rng = np.random.default_rng(7)
+    path = tmp_path / "adversarial.csv"
+    fragments = [
+        "love", "tears", "café", "don't", "'''", "a,b", 'he said ""hi""',
+        "line1\nline2", "  padded  ", "x" * 500, "", "naïveté",
+        "end with comma,", ",start with comma", 'quote " inside',
+    ]
+    with open(path, "w", newline="", encoding="utf-8") as fh:
+        writer = csv.writer(fh)
+        writer.writerow(["artist", "song", "link", "text"])
+        for i in range(500):
+            parts = rng.choice(fragments, size=rng.integers(1, 6))
+            text = " ".join(parts)
+            artist = ["ABBA", "Earth, Wind & Fire", "", 'A "quoted" name',
+                      "José González"][int(rng.integers(0, 5))]
+            writer.writerow([artist, f"S{i}", f"/l/{i}", text])
+    n = native.ingest_native(str(path))
+    p = ingest_python(path.read_bytes())
+    assert_parity(n, p)
+
+
+def test_synthetic_parity_and_threads(tmp_path):
+    from music_analyst_tpu.data.synthetic import generate_dataset
+
+    path = tmp_path / "synthetic.csv"
+    generate_dataset(str(path), num_songs=2000, seed=3)
+    p = ingest_python(path.read_bytes())
+    for threads in (1, 4, 8):
+        n = native.ingest_native(str(path), num_threads=threads)
+        assert_parity(n, p)
+
+
+def test_limit_parity(fixture_csv):
+    n = native.ingest_native(str(fixture_csv), limit=3)
+    p = ingest_python(fixture_csv.read_bytes(), limit=3)
+    assert_parity(n, p)
+
+
+def test_crlf_dataset(tmp_path):
+    path = tmp_path / "crlf.csv"
+    data = (
+        b"artist,song,link,text\r\n"
+        b'A,S1,/l,"hello world line"\r\n'
+        b"B,S2,/l,short words here\r\n"
+    )
+    path.write_bytes(data)
+    n = native.ingest_native(str(path))
+    p = ingest_python(data)
+    assert_parity(n, p)

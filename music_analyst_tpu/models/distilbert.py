@@ -1,0 +1,259 @@
+"""DistilBERT-sst2-style encoder classifier (BASELINE.json config[2]).
+
+The batched on-device replacement for the reference's per-song HTTP loop
+(``scripts/sentiment_classifier.py:85-100``): a 6-layer post-LN transformer
+encoder with learned positions and a CLS head, matching the
+``distilbert-base-uncased-finetuned-sst-2-english`` architecture so real
+checkpoints drop in when available (``load_hf_torch_checkpoint``), while
+random init keeps the pipeline, sharding, and benchmarks runnable in this
+zero-egress environment.
+
+Label contract: sst2 is 2-class (negative/positive).  The mapping onto the
+reference's 3-label API (SURVEY.md §7 step 5 — "documented mapping") is
+confidence-thresholded: ``max softmax prob < neutral_threshold`` →
+``Neutral``, else argmax → ``Positive``/``Negative``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from functools import partial
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from music_analyst_tpu.engines.sentiment import ClassifierBackend
+from music_analyst_tpu.models.layers import (
+    GeluMLP,
+    MultiHeadAttention,
+    padding_mask,
+)
+from music_analyst_tpu.models.tokenization import resolve_bert_tokenizer
+
+
+@dataclasses.dataclass(frozen=True)
+class DistilBertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 6
+    n_heads: int = 12
+    hidden_dim: int = 3072
+    max_positions: int = 512
+    n_classes: int = 2
+    dtype: str = "bfloat16"
+
+    @classmethod
+    def tiny(cls) -> "DistilBertConfig":
+        return cls(vocab_size=1024, dim=64, n_layers=2, n_heads=4,
+                   hidden_dim=128, max_positions=128)
+
+
+class TransformerBlock(nn.Module):
+    """Post-LN block: x → LN(x + attn(x)) → LN(· + mlp(·))."""
+
+    config: DistilBertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        attn_out = MultiHeadAttention(
+            n_heads=cfg.n_heads, dtype=dtype, name="attention"
+        )(x, mask=mask)
+        x = nn.LayerNorm(name="sa_layer_norm", dtype=dtype)(x + attn_out)
+        mlp_out = GeluMLP(cfg.hidden_dim, dtype=dtype, name="ffn")(x)
+        return nn.LayerNorm(name="output_layer_norm", dtype=dtype)(x + mlp_out)
+
+
+class DistilBertEncoder(nn.Module):
+    config: DistilBertConfig
+
+    @nn.compact
+    def __call__(self, token_ids, lengths):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        positions = jnp.arange(token_ids.shape[1])[None, :]
+        tok = nn.Embed(cfg.vocab_size, cfg.dim, dtype=dtype,
+                       name="word_embeddings")(token_ids)
+        pos = nn.Embed(cfg.max_positions, cfg.dim, dtype=dtype,
+                       name="position_embeddings")(positions)
+        x = nn.LayerNorm(name="embed_layer_norm", dtype=dtype)(tok + pos)
+        mask = padding_mask(lengths, token_ids.shape[1])
+        for i in range(cfg.n_layers):
+            x = TransformerBlock(cfg, name=f"layer_{i}")(x, mask)
+        return x
+
+
+class DistilBertForSentiment(nn.Module):
+    """Encoder + CLS head → class logits."""
+
+    config: DistilBertConfig
+
+    @nn.compact
+    def __call__(self, token_ids, lengths):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        x = DistilBertEncoder(cfg, name="encoder")(token_ids, lengths)
+        cls = x[:, 0]  # [CLS]
+        h = nn.Dense(cfg.dim, dtype=dtype, name="pre_classifier")(cls)
+        h = nn.relu(h)
+        return nn.Dense(cfg.n_classes, dtype=jnp.float32, name="classifier")(h)
+
+
+def load_hf_torch_checkpoint(params, path: str):
+    """Map an HF DistilBERT torch ``state_dict`` onto the Flax params.
+
+    Accepts a ``pytorch_model.bin`` path; kernel matrices transpose
+    (torch Linear stores ``[out, in]``), attention projections reshape to
+    ``[dim, heads, head_dim]``.  Unmatched reference keys raise.
+    """
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    cfg_heads = params["encoder"]["layer_0"]["attention"]["q_proj"]["kernel"].shape[1]
+
+    def t(name):
+        return np.asarray(sd[name].numpy())
+
+    new = jax.tree_util.tree_map(lambda x: x, params)  # shallow copy
+    enc = new["encoder"]
+    enc["word_embeddings"]["embedding"] = t(
+        "distilbert.embeddings.word_embeddings.weight"
+    )
+    enc["position_embeddings"]["embedding"] = t(
+        "distilbert.embeddings.position_embeddings.weight"
+    )
+    enc["embed_layer_norm"]["scale"] = t("distilbert.embeddings.LayerNorm.weight")
+    enc["embed_layer_norm"]["bias"] = t("distilbert.embeddings.LayerNorm.bias")
+    n_layers = sum(1 for k in enc if k.startswith("layer_"))
+    for i in range(n_layers):
+        hf = f"distilbert.transformer.layer.{i}"
+        layer = enc[f"layer_{i}"]
+        attn = layer["attention"]
+        dim = enc["word_embeddings"]["embedding"].shape[1]
+        head_dim = dim // cfg_heads
+        for ours, theirs in (("q_proj", "q_lin"), ("k_proj", "k_lin"),
+                             ("v_proj", "v_lin")):
+            w = t(f"{hf}.attention.{theirs}.weight").T  # [in, out]
+            attn[ours]["kernel"] = w.reshape(dim, cfg_heads, head_dim)
+        attn["o_proj"]["kernel"] = (
+            t(f"{hf}.attention.out_lin.weight").T.reshape(cfg_heads, head_dim, dim)
+        )
+        layer["sa_layer_norm"]["scale"] = t(f"{hf}.sa_layer_norm.weight")
+        layer["sa_layer_norm"]["bias"] = t(f"{hf}.sa_layer_norm.bias")
+        layer["ffn"]["lin1"]["kernel"] = t(f"{hf}.ffn.lin1.weight").T
+        layer["ffn"]["lin1"]["bias"] = t(f"{hf}.ffn.lin1.bias")
+        layer["ffn"]["lin2"]["kernel"] = t(f"{hf}.ffn.lin2.weight").T
+        layer["ffn"]["lin2"]["bias"] = t(f"{hf}.ffn.lin2.bias")
+        layer["output_layer_norm"]["scale"] = t(f"{hf}.output_layer_norm.weight")
+        layer["output_layer_norm"]["bias"] = t(f"{hf}.output_layer_norm.bias")
+    new["pre_classifier"]["kernel"] = t("pre_classifier.weight").T
+    new["pre_classifier"]["bias"] = t("pre_classifier.bias")
+    new["classifier"]["kernel"] = t("classifier.weight").T
+    new["classifier"]["bias"] = t("classifier.bias")
+    return new
+
+
+class DistilBertClassifier(ClassifierBackend):
+    """Batched data-parallel sentiment backend."""
+
+    name = "distilbert"
+
+    # sst2 head order in the HF checkpoint: [NEGATIVE, POSITIVE]
+    _CLASS_LABELS = ("Negative", "Positive")
+
+    def __init__(
+        self,
+        config: Optional[DistilBertConfig] = None,
+        checkpoint_path: Optional[str] = None,
+        max_len: int = 128,
+        neutral_threshold: float = 0.6,
+        mesh=None,
+        seed: int = 0,
+        vocab_path: Optional[str] = None,
+    ) -> None:
+        self.config = config or DistilBertConfig()
+        self.max_len = max_len
+        self.neutral_threshold = neutral_threshold
+        self.tokenizer = resolve_bert_tokenizer(
+            vocab_path, vocab_size=self.config.vocab_size
+        )
+        self.model = DistilBertForSentiment(self.config)
+        dummy = (
+            jnp.zeros((1, max_len), jnp.int32),
+            jnp.ones((1,), jnp.int32),
+        )
+        self.params = self.model.init(jax.random.key(seed), *dummy)["params"]
+        self.pretrained = False
+        if checkpoint_path:
+            self.params = load_hf_torch_checkpoint(self.params, checkpoint_path)
+            self.pretrained = True
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self.params = jax.device_put(
+                self.params, NamedSharding(mesh, P())
+            )
+            self._data_sharding = NamedSharding(mesh, P("dp"))
+        else:
+            self._data_sharding = None
+        self.mesh = mesh
+
+        @jax.jit
+        def _forward(params, token_ids, lengths):
+            logits = self.model.apply({"params": params}, token_ids, lengths)
+            probs = jax.nn.softmax(logits, axis=-1)
+            return jnp.argmax(logits, axis=-1), jnp.max(probs, axis=-1)
+
+        self._forward = _forward
+
+    @classmethod
+    def from_pretrained_or_random(cls, model: str, **kwargs):
+        """Resolve ``--model distilbert[...]`` to a backend instance.
+
+        Checkpoint lookup: explicit kwarg, else ``$MUSICAAL_DISTILBERT_CKPT``.
+        Without a checkpoint the model runs with random weights (documented:
+        throughput/sharding are exercised; accuracy needs real weights).
+        """
+        ckpt = kwargs.pop("checkpoint_path", None) or os.environ.get(
+            "MUSICAAL_DISTILBERT_CKPT"
+        )
+        config = kwargs.pop("config", None)
+        if model.endswith("-tiny"):
+            config = config or DistilBertConfig.tiny()
+        return cls(config=config, checkpoint_path=ckpt, **kwargs)
+
+    def _pad_batch(self, batch: np.ndarray, lengths: np.ndarray):
+        """Pad the row count so the batch splits evenly over the dp axis."""
+        if self.mesh is None:
+            return batch, lengths, batch.shape[0]
+        shards = self.mesh.shape.get("dp", 1)
+        n = batch.shape[0]
+        padded = -(-n // shards) * shards
+        if padded != n:
+            batch = np.pad(batch, ((0, padded - n), (0, 0)))
+            lengths = np.pad(lengths, (0, padded - n), constant_values=1)
+        return batch, lengths, n
+
+    def classify_batch(self, texts: Sequence[str]) -> List[str]:
+        token_ids, lengths = self.tokenizer.encode_batch(texts, self.max_len)
+        token_ids, lengths, n = self._pad_batch(token_ids, lengths)
+        if self._data_sharding is not None:
+            token_ids = jax.device_put(token_ids, self._data_sharding)
+            lengths = jax.device_put(lengths, self._data_sharding)
+        classes, confidence = self._forward(self.params, token_ids, lengths)
+        classes = np.asarray(classes)[:n]
+        confidence = np.asarray(confidence)[:n]
+        labels: List[str] = []
+        for text, cls_id, conf in zip(texts, classes, confidence):
+            if not text.strip():
+                labels.append("Neutral")  # reference empty-lyric rule
+            elif conf < self.neutral_threshold:
+                labels.append("Neutral")
+            else:
+                labels.append(self._CLASS_LABELS[int(cls_id)])
+        return labels

@@ -1,0 +1,241 @@
+"""Shared Flax building blocks for the model families.
+
+Written TPU-first: bfloat16 activations by default (MXU-native), static
+shapes everywhere, fused residual blocks XLA can pipeline, and attention
+formulated so heads can be sharded over the ``tp`` mesh axis (head counts
+are kept divisible by the tp degree by construction in the model configs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def rope_frequencies(
+    head_dim: int, max_positions: int, theta: float = 10_000.0
+) -> Tuple[jax.Array, jax.Array]:
+    """Precompute RoPE cos/sin tables ``[max_positions, head_dim/2]``."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    positions = jnp.arange(max_positions, dtype=jnp.float32)
+    angles = jnp.outer(positions, inv_freq)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(
+    x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array
+) -> jax.Array:
+    """Rotate ``x [B, S, H, D]`` by position-dependent angles.
+
+    ``positions [B, S]`` indexes the precomputed tables, supporting both
+    prefill (0..S) and decode (cache_len + step) without recompilation.
+    """
+    cos_p = cos[positions][:, :, None, :]  # [B, S, 1, D/2]
+    sin_p = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    rotated = jnp.concatenate(
+        (x1 * cos_p - x2 * sin_p, x2 * cos_p + x1 * sin_p), axis=-1
+    )
+    return rotated.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    """Root-mean-square norm (no mean subtraction), fp32 accumulation."""
+
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(
+            jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + self.epsilon
+        )
+        return (normed * scale).astype(x.dtype)
+
+
+@dataclasses.dataclass
+class KVCache:
+    """Per-layer decode cache; keys/values ``[B, max_len, n_kv_heads, D]``.
+
+    Replaces nothing in the reference (its LLM path is a remote Ollama
+    server, ``scripts/sentiment_classifier.py:85-100``); on TPU the cache is
+    an explicit on-device buffer whose head axis shards over ``tp`` so
+    decode attention stays local to each chip.
+    """
+
+    keys: jax.Array
+    values: jax.Array
+    length: jax.Array  # int32 scalar — filled positions
+
+    @classmethod
+    def zeros(
+        cls,
+        batch: int,
+        max_len: int,
+        n_kv_heads: int,
+        head_dim: int,
+        dtype=jnp.bfloat16,
+    ) -> "KVCache":
+        shape = (batch, max_len, n_kv_heads, head_dim)
+        return cls(
+            keys=jnp.zeros(shape, dtype),
+            values=jnp.zeros(shape, dtype),
+            length=jnp.zeros((), jnp.int32),
+        )
+
+    def update(self, k_new: jax.Array, v_new: jax.Array) -> "KVCache":
+        start = self.length
+        keys = jax.lax.dynamic_update_slice(
+            self.keys, k_new.astype(self.keys.dtype), (0, start, 0, 0)
+        )
+        values = jax.lax.dynamic_update_slice(
+            self.values, v_new.astype(self.values.dtype), (0, start, 0, 0)
+        )
+        return KVCache(keys, values, start + k_new.shape[1])
+
+
+jax.tree_util.register_dataclass(
+    KVCache, data_fields=["keys", "values", "length"], meta_fields=[]
+)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Plain attention ``[B, S, H, D]`` with fp32 softmax accumulation.
+
+    Grouped-query support: when ``k``/``v`` carry fewer heads than ``q``,
+    KV heads are broadcast over the query-head groups (Llama-3 GQA).
+    """
+    n_q_heads = q.shape[2]
+    n_kv_heads = k.shape[2]
+    if n_kv_heads != n_q_heads:
+        group = n_q_heads // n_kv_heads
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class MultiHeadAttention(nn.Module):
+    """MHA/GQA with optional RoPE and optional KV cache.
+
+    Projections use a single fused kernel per Q/K/V/O so each matmul is
+    large enough to tile onto the MXU; head axes are laid out so a ``tp``
+    sharding splits ``n_heads`` (and ``n_kv_heads``) without resharding.
+    """
+
+    n_heads: int
+    n_kv_heads: Optional[int] = None
+    head_dim: Optional[int] = None
+    use_rope: bool = False
+    rope_theta: float = 10_000.0
+    max_positions: int = 4096
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        mask: Optional[jax.Array] = None,
+        positions: Optional[jax.Array] = None,
+        cache: Optional[KVCache] = None,
+    ):
+        features = x.shape[-1]
+        n_kv = self.n_kv_heads or self.n_heads
+        head_dim = self.head_dim or features // self.n_heads
+        dense = lambda feats, name: nn.DenseGeneral(  # noqa: E731
+            features=feats,
+            axis=-1,
+            use_bias=False,
+            dtype=self.dtype,
+            name=name,
+        )
+        q = dense((self.n_heads, head_dim), "q_proj")(x)
+        k = dense((n_kv, head_dim), "k_proj")(x)
+        v = dense((n_kv, head_dim), "v_proj")(x)
+
+        if self.use_rope:
+            if positions is None:
+                positions = jnp.broadcast_to(
+                    jnp.arange(x.shape[1]), x.shape[:2]
+                )
+            cos, sin = rope_frequencies(
+                head_dim, self.max_positions, self.rope_theta
+            )
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+
+        new_cache = None
+        if cache is not None:
+            new_cache = cache.update(k, v)
+            k, v = new_cache.keys, new_cache.values
+
+        out = dot_product_attention(q, k, v, mask)
+        out = nn.DenseGeneral(
+            features=features,
+            axis=(-2, -1),
+            use_bias=False,
+            dtype=self.dtype,
+            name="o_proj",
+        )(out)
+        if cache is not None:
+            return out, new_cache
+        return out
+
+
+class SwiGLU(nn.Module):
+    """Llama-style gated MLP; hidden dim shards over ``tp``."""
+
+    hidden_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        features = x.shape[-1]
+        gate = nn.Dense(self.hidden_dim, use_bias=False, dtype=self.dtype,
+                        name="gate_proj")(x)
+        up = nn.Dense(self.hidden_dim, use_bias=False, dtype=self.dtype,
+                      name="up_proj")(x)
+        return nn.Dense(features, use_bias=False, dtype=self.dtype,
+                        name="down_proj")(nn.silu(gate) * up)
+
+
+class GeluMLP(nn.Module):
+    """BERT-style 2-layer MLP with biases."""
+
+    hidden_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        features = x.shape[-1]
+        h = nn.Dense(self.hidden_dim, dtype=self.dtype, name="lin1")(x)
+        h = nn.gelu(h, approximate=False)
+        return nn.Dense(features, dtype=self.dtype, name="lin2")(h)
+
+
+def causal_mask(q_len: int, kv_len: int, offset) -> jax.Array:
+    """``[1, 1, q_len, kv_len]`` causal mask with a dynamic cache offset."""
+    q_pos = jnp.arange(q_len)[:, None] + offset
+    kv_pos = jnp.arange(kv_len)[None, :]
+    return (kv_pos <= q_pos)[None, None, :, :]
+
+
+def padding_mask(lengths: jax.Array, max_len: int) -> jax.Array:
+    """``[B, 1, 1, max_len]`` key-padding mask from per-row lengths."""
+    return (jnp.arange(max_len)[None, :] < lengths[:, None])[:, None, None, :]

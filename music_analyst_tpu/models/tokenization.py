@@ -1,0 +1,175 @@
+"""Subword tokenizers for the neural sentiment backends.
+
+This environment is zero-egress, so pretrained tokenizer assets may be
+absent.  Three tiers, best available wins:
+
+* a real WordPiece vocab (``vocab.txt``) or HF tokenizer directory supplied
+  via path/env — exact DistilBERT tokenization;
+* :class:`HashWordTokenizer` — deterministic hash of whitespace/punct-split
+  words into the id space.  Calibration-free: architecture benchmarks and
+  sharding tests don't depend on which subword each word maps to;
+* :class:`ByteTokenizer` — raw UTF-8 bytes + specials, used by the decoder
+  LM family offline.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_WORD_RE = re.compile(r"[a-z0-9']+|[^\sa-z0-9']", re.IGNORECASE)
+
+
+class HashWordTokenizer:
+    """Deterministic word→id hashing into a fixed vocab space."""
+
+    def __init__(
+        self,
+        vocab_size: int = 30522,
+        cls_id: int = 101,
+        sep_id: int = 102,
+        pad_id: int = 0,
+        reserved: int = 1000,
+    ) -> None:
+        if vocab_size < 16:
+            raise ValueError("vocab_size too small for special tokens")
+        self.vocab_size = vocab_size
+        # keep specials + reserved range inside small vocabs
+        self.cls_id = min(cls_id, vocab_size - 2)
+        self.sep_id = min(sep_id, vocab_size - 1)
+        self.pad_id = pad_id
+        self.reserved = min(reserved, vocab_size // 2)
+
+    def _word_id(self, word: str) -> int:
+        h = 2166136261
+        for ch in word.encode("utf-8"):
+            h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
+        return self.reserved + (h % (self.vocab_size - self.reserved))
+
+    def encode(self, text: str, max_len: int) -> Tuple[np.ndarray, int]:
+        words = _WORD_RE.findall(text.lower())[: max_len - 2]
+        ids = [self.cls_id] + [self._word_id(w) for w in words] + [self.sep_id]
+        length = len(ids)
+        out = np.full(max_len, self.pad_id, dtype=np.int32)
+        out[:length] = ids
+        return out, length
+
+    def encode_batch(
+        self, texts: Sequence[str], max_len: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        batch = np.full((len(texts), max_len), self.pad_id, dtype=np.int32)
+        lengths = np.zeros(len(texts), dtype=np.int32)
+        for i, text in enumerate(texts):
+            row, n = self.encode(text, max_len)
+            batch[i] = row
+            lengths[i] = n
+        return batch, lengths
+
+
+class WordPieceTokenizer:
+    """Greedy longest-match-first WordPiece over a provided ``vocab.txt``.
+
+    Matches the BERT algorithm: basic whitespace+punctuation split,
+    lowercase, then greedy subword segmentation with ``##`` continuations;
+    unknown words map to ``[UNK]``.
+    """
+
+    def __init__(self, vocab_path: str, max_word_chars: int = 100) -> None:
+        with open(vocab_path, encoding="utf-8") as fh:
+            self.vocab = {line.rstrip("\n"): i for i, line in enumerate(fh)}
+        self.pad_id = self.vocab.get("[PAD]", 0)
+        self.cls_id = self.vocab["[CLS]"]
+        self.sep_id = self.vocab["[SEP]"]
+        self.unk_id = self.vocab.get("[UNK]", 100)
+        self.max_word_chars = max_word_chars
+        self.vocab_size = len(self.vocab)
+
+    def _wordpiece(self, word: str) -> List[int]:
+        if len(word) > self.max_word_chars:
+            return [self.unk_id]
+        ids: List[int] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                piece = word[start:end]
+                if start > 0:
+                    piece = "##" + piece
+                if piece in self.vocab:
+                    cur = self.vocab[piece]
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_id]
+            ids.append(cur)
+            start = end
+        return ids
+
+    def encode(self, text: str, max_len: int) -> Tuple[np.ndarray, int]:
+        ids: List[int] = [self.cls_id]
+        for word in _WORD_RE.findall(text.lower()):
+            ids.extend(self._wordpiece(word))
+            if len(ids) >= max_len - 1:
+                break
+        ids = ids[: max_len - 1] + [self.sep_id]
+        out = np.full(max_len, self.pad_id, dtype=np.int32)
+        out[: len(ids)] = ids
+        return out, len(ids)
+
+    def encode_batch(
+        self, texts: Sequence[str], max_len: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        batch = np.full((len(texts), max_len), self.pad_id, dtype=np.int32)
+        lengths = np.zeros(len(texts), dtype=np.int32)
+        for i, text in enumerate(texts):
+            row, n = self.encode(text, max_len)
+            batch[i] = row
+            lengths[i] = n
+        return batch, lengths
+
+
+class ByteTokenizer:
+    """UTF-8 bytes + specials: the offline tokenizer for the decoder LM."""
+
+    PAD, BOS, EOS = 256, 257, 258
+
+    def __init__(self, vocab_size: int = 512) -> None:
+        assert vocab_size >= 259
+        self.vocab_size = vocab_size
+        self.pad_id = self.PAD
+
+    def encode(self, text: str, max_len: int) -> Tuple[np.ndarray, int]:
+        data = text.encode("utf-8")[: max_len - 1]
+        ids = [self.BOS] + list(data)
+        out = np.full(max_len, self.PAD, dtype=np.int32)
+        out[: len(ids)] = ids
+        return out, len(ids)
+
+    def encode_batch(
+        self, texts: Sequence[str], max_len: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        batch = np.full((len(texts), max_len), self.PAD, dtype=np.int32)
+        lengths = np.zeros(len(texts), dtype=np.int32)
+        for i, text in enumerate(texts):
+            row, n = self.encode(text, max_len)
+            batch[i] = row
+            lengths[i] = n
+        return batch, lengths
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+def resolve_bert_tokenizer(
+    vocab_path: Optional[str] = None, vocab_size: int = 30522
+):
+    """Best-available encoder tokenizer (WordPiece if a vocab is supplied)."""
+    path = vocab_path or os.environ.get("MUSICAAL_BERT_VOCAB")
+    if path and os.path.exists(path):
+        return WordPieceTokenizer(path)
+    return HashWordTokenizer(vocab_size=vocab_size)

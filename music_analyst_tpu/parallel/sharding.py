@@ -1,0 +1,78 @@
+"""Parameter partition rules: param-tree paths → ``PartitionSpec``.
+
+The tensor-parallel layout follows the Megatron/scaling-book recipe: QKV
+projections split the *head* axis over ``tp`` and the output projection
+splits the *input* head axis (one all-reduce per attention block); MLP
+up/gate split the hidden axis, down splits the input axis (one all-reduce
+per MLP); embeddings and the LM head split the vocab axis.  Norm scales and
+biases replicate.  XLA inserts the psums from these shardings — there is no
+hand-written collective in the model code.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (path regex, spec) — first match wins.  Paths are "/"-joined param tree
+# keys, e.g. "encoder/layer_0/attention/q_proj/kernel".
+TP_RULES: List[Tuple[str, P]] = [
+    # attention: kernel [dim, heads, head_dim] — shard heads
+    (r".*(q_proj|k_proj|v_proj)/kernel$", P(None, "tp", None)),
+    # output proj: kernel [heads, head_dim, dim] — shard input heads
+    (r".*o_proj/kernel$", P("tp", None, None)),
+    # gated MLP: [dim, hidden] / [hidden, dim]
+    (r".*(gate_proj|up_proj)/kernel$", P(None, "tp")),
+    (r".*down_proj/kernel$", P("tp", None)),
+    # BERT-style MLP
+    (r".*ffn/lin1/kernel$", P(None, "tp")),
+    (r".*ffn/lin2/kernel$", P("tp", None)),
+    (r".*ffn/lin1/bias$", P("tp")),
+    # vocab-sharded embedding + LM head
+    (r".*(word_embeddings|tok_embeddings)/embedding$", P("tp", None)),
+    (r".*lm_head/kernel$", P(None, "tp")),
+]
+
+
+def spec_for_path(path: str, rules=None) -> P:
+    for pattern, spec in rules or TP_RULES:
+        if re.match(pattern, path):
+            return spec
+    return P()  # replicate
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        parts.append(str(getattr(p, "key", getattr(p, "idx", p))))
+    return "/".join(parts)
+
+
+def partition_specs(params, rules=None):
+    """PartitionSpec pytree matching ``params``."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: spec_for_path(_path_str(path), rules), params
+    )
+
+
+def shard_params(params, mesh: Mesh, rules=None, drop_unused_axes: bool = True):
+    """Place a param tree on ``mesh`` according to the rules.
+
+    Axes named in a rule but absent from the mesh are dropped from the spec
+    (so the same rules serve a dp-only mesh, a dp×tp mesh, etc.).
+    """
+    axis_names = set(mesh.axis_names)
+
+    def _prune(spec: P) -> P:
+        return P(*(a if a in axis_names else None for a in spec))
+
+    def _place(path, leaf):
+        spec = spec_for_path(_path_str(path), rules)
+        if drop_unused_axes:
+            spec = _prune(spec)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(_place, params)

@@ -1,0 +1,192 @@
+"""Model families: shapes, KV-cache consistency, TP-sharded equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from music_analyst_tpu.models.distilbert import (
+    DistilBertClassifier,
+    DistilBertConfig,
+)
+from music_analyst_tpu.models.llama import (
+    LlamaConfig,
+    LlamaModel,
+    LlamaZeroShotClassifier,
+    init_caches,
+)
+from music_analyst_tpu.models.layers import causal_mask, padding_mask
+from music_analyst_tpu.parallel.mesh import build_mesh, factor_devices
+from music_analyst_tpu.parallel.sharding import shard_params
+from music_analyst_tpu.utils.labels import SUPPORTED_LABELS
+
+
+class TestDistilBert:
+    @pytest.fixture(scope="class")
+    def clf(self):
+        return DistilBertClassifier(
+            config=DistilBertConfig.tiny(), max_len=32
+        )
+
+    def test_forward_shapes(self, clf):
+        ids = jnp.zeros((3, 32), jnp.int32)
+        lens = jnp.array([5, 1, 32], jnp.int32)
+        logits = clf.model.apply({"params": clf.params}, ids, lens)
+        assert logits.shape == (3, 2)
+        assert logits.dtype == jnp.float32
+
+    def test_padding_invariance(self, clf):
+        """Garbage in padded positions must not change the prediction."""
+        rng = np.random.default_rng(0)
+        ids_a = np.zeros((1, 32), np.int32)
+        ids_a[0, :6] = [101, 7, 8, 9, 10, 102]
+        ids_b = ids_a.copy()
+        ids_b[0, 6:] = rng.integers(1, 1000, 26)
+        lens = jnp.array([6], jnp.int32)
+        la = clf.model.apply({"params": clf.params}, jnp.asarray(ids_a), lens)
+        lb = clf.model.apply({"params": clf.params}, jnp.asarray(ids_b), lens)
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=2e-2)
+
+    def test_classify_batch_contract(self, clf):
+        labels = clf.classify_batch(["i love this", "", "terrible pain"])
+        assert all(l in SUPPORTED_LABELS for l in labels)
+        assert labels[1] == "Neutral"  # empty lyric rule
+
+    def test_neutral_threshold_extremes(self):
+        clf = DistilBertClassifier(
+            config=DistilBertConfig.tiny(), max_len=16, neutral_threshold=1.1
+        )
+        # threshold > 1 -> everything Neutral
+        assert clf.classify_batch(["anything at all"]) == ["Neutral"]
+
+
+class TestLlama:
+    @pytest.fixture(scope="class")
+    def clf(self):
+        return LlamaZeroShotClassifier(
+            config=LlamaConfig.tiny(), max_prompt_len=160
+        )
+
+    def test_prefill_matches_no_cache(self, clf):
+        """Prefill-with-cache logits == plain forward logits."""
+        cfg = clf.config
+        B, S = 2, 12
+        ids = jnp.asarray(
+            np.random.default_rng(1).integers(0, 256, (B, S)), jnp.int32
+        )
+        pos = jnp.arange(S)[None, :].repeat(B, 0)
+        mask = causal_mask(S, S, 0)
+        plain, _ = clf.model.apply({"params": clf.params}, ids, pos, mask)
+        caches = init_caches(cfg, B, S + 4)
+        mask_c = causal_mask(S, S + 4, 0)
+        cached, caches = clf.model.apply(
+            {"params": clf.params}, ids, pos, mask_c, caches
+        )
+        np.testing.assert_allclose(
+            np.asarray(plain), np.asarray(cached), rtol=2e-2, atol=2e-2
+        )
+
+    def test_incremental_decode_matches_full_forward(self, clf):
+        """Token-by-token decode reproduces the full-sequence argmax path."""
+        cfg = clf.config
+        rng = np.random.default_rng(2)
+        S = 10
+        ids = jnp.asarray(rng.integers(0, 256, (1, S)), jnp.int32)
+        pos = jnp.arange(S)[None, :]
+        full_logits, _ = clf.model.apply(
+            {"params": clf.params}, ids, pos, causal_mask(S, S, 0)
+        )
+        # incremental: prefill first 5, then decode 5 one at a time
+        caches = init_caches(cfg, 1, S)
+        pre = 5
+        logits_p, caches = clf.model.apply(
+            {"params": clf.params},
+            ids[:, :pre],
+            pos[:, :pre],
+            causal_mask(pre, S, 0),
+            caches,
+        )
+        step_logits = [logits_p[:, -1]]
+        for t in range(pre, S):
+            kv_pos = jnp.arange(S)[None, None, None, :]
+            mask = kv_pos <= t
+            logits_t, caches = clf.model.apply(
+                {"params": clf.params},
+                ids[:, t : t + 1],
+                pos[:, t : t + 1],
+                mask,
+                caches,
+            )
+            step_logits.append(logits_t[:, -1])
+        for t in range(pre, S):
+            np.testing.assert_allclose(
+                np.asarray(full_logits[:, t - 1]),
+                np.asarray(step_logits[t - pre]),
+                rtol=5e-2,
+                atol=5e-2,
+            )
+
+    def test_classify_batch_contract(self, clf):
+        labels = clf.classify_batch(["love and joy", "", "tears of pain"])
+        assert all(l in SUPPORTED_LABELS for l in labels)
+        assert labels[1] == "Neutral"
+
+    def test_generation_path(self, clf):
+        text = clf.generate("hello", max_new_tokens=4)
+        assert isinstance(text, str)
+        label = clf.classify_by_generation("some lyrics here")
+        assert label in SUPPORTED_LABELS
+
+    def test_preset_llama3_requires_checkpoint(self):
+        with pytest.raises(RuntimeError, match="checkpoint"):
+            LlamaZeroShotClassifier.from_pretrained_or_random("llama3")
+
+
+class TestTensorParallel:
+    def test_sharded_forward_matches_single_device(self):
+        """dp×tp sharded forward == unsharded forward (same params)."""
+        cfg = LlamaConfig.tiny()
+        model = LlamaModel(cfg)
+        rng = np.random.default_rng(3)
+        ids = jnp.asarray(rng.integers(0, 256, (4, 16)), jnp.int32)
+        pos = jnp.arange(16)[None, :].repeat(4, 0)
+        mask = causal_mask(16, 16, 0)
+        params = model.init(jax.random.key(0), ids, pos, mask)["params"]
+        ref_logits, _ = model.apply({"params": params}, ids, pos, mask)
+
+        mesh = build_mesh(factor_devices(8, ("dp", "tp"), fixed={"tp": 4}))
+        sharded = shard_params(params, mesh)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        ids_s = jax.device_put(ids, NamedSharding(mesh, P("dp")))
+        pos_s = jax.device_put(pos, NamedSharding(mesh, P("dp")))
+        out, _ = jax.jit(
+            lambda p, i, q: model.apply({"params": p}, i, q, mask)
+        )(sharded, ids_s, pos_s)
+        ref_np, out_np = np.asarray(ref_logits), np.asarray(out)
+        # bf16 all-reduce ordering differs across shards; demand near-total
+        # elementwise agreement plus identical argmax decisions.
+        close = np.isclose(ref_np, out_np, rtol=3e-2, atol=3e-2)
+        assert close.mean() > 0.999
+        assert (ref_np.argmax(-1) == out_np.argmax(-1)).mean() > 0.99
+
+    def test_partition_specs_cover_attention_and_mlp(self):
+        cfg = LlamaConfig.tiny()
+        model = LlamaModel(cfg)
+        ids = jnp.zeros((1, 8), jnp.int32)
+        pos = jnp.zeros((1, 8), jnp.int32)
+        params = model.init(jax.random.key(0), ids, pos, causal_mask(8, 8, 0))[
+            "params"
+        ]
+        from music_analyst_tpu.parallel.sharding import partition_specs
+        from jax.sharding import PartitionSpec as P
+
+        specs = partition_specs(params)
+        l0 = specs["layer_0"]
+        assert l0["attention"]["q_proj"]["kernel"] == P(None, "tp", None)
+        assert l0["attention"]["o_proj"]["kernel"] == P("tp", None, None)
+        assert l0["feed_forward"]["gate_proj"]["kernel"] == P(None, "tp")
+        assert l0["feed_forward"]["down_proj"]["kernel"] == P("tp", None)
+        assert specs["tok_embeddings"]["embedding"] == P("tp", None)
+        assert specs["lm_head"]["kernel"] == P(None, "tp")
+        assert specs["norm"]["scale"] == P()

@@ -1,0 +1,64 @@
+"""Round benchmark: batched sentiment throughput on the real chip.
+
+Headline metric (BASELINE.md): songs/sec sentiment-classified.  The driver
+target is all ~1M songs in < 60 s on a v5e-8 ⇒ ≥ ~16,667 songs/s pod-wide,
+i.e. ≥ ~2,083 songs/s *per chip*.  This bench runs the full-size
+DistilBERT-sst2 architecture (66M params, seq len 128, bf16) end-to-end —
+host tokenization included — on however many chips are visible (one, under
+the round driver) and reports songs/sec with ``vs_baseline`` = measured /
+per-chip share of the target.
+
+Prints exactly ONE JSON line on stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+PER_CHIP_TARGET = 16_667 / 8  # songs/sec per chip for the <60s/1M goal
+
+
+def main() -> int:
+    import jax
+
+    n_chips = len(jax.devices())
+
+    from music_analyst_tpu.data.synthetic import generate_dataset
+    from music_analyst_tpu.data.csv_io import iter_songs
+    from music_analyst_tpu.models.distilbert import DistilBertClassifier
+
+    dataset = "/tmp/musicaal_bench_songs.csv"
+    n_songs = 16_384
+    if not os.path.exists(dataset):
+        generate_dataset(dataset, num_songs=n_songs, seed=11)
+    texts = [text for _, _, text in iter_songs(dataset)]
+
+    clf = DistilBertClassifier(max_len=128)
+    batch = 2048
+
+    # Warmup: compile + first dispatch.
+    clf.classify_batch(texts[:batch])
+
+    start = time.perf_counter()
+    done = 0
+    while done < len(texts):
+        clf.classify_batch(texts[done : done + batch])
+        done += batch
+    elapsed = time.perf_counter() - start
+
+    songs_per_sec = len(texts) / elapsed
+    result = {
+        "metric": "sentiment_songs_per_sec_distilbert",
+        "value": round(songs_per_sec, 1),
+        "unit": f"songs/sec on {n_chips} chip(s), seq128 bf16, host tokenize included",
+        "vs_baseline": round(songs_per_sec / (PER_CHIP_TARGET * n_chips), 3),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

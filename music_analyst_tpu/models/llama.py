@@ -62,6 +62,10 @@ class LlamaConfig:
     rope_theta: float = 500_000.0
     max_seq_len: int = 8192
     dtype: str = "bfloat16"
+    # > 0 replaces the dense SwiGLU with a routed mixture of experts whose
+    # expert axis shards over the ``ep`` mesh axis (models/moe.py).
+    n_experts: int = 0
+    moe_top_k: int = 2
 
     @classmethod
     def llama3_8b(cls) -> "LlamaConfig":
@@ -111,7 +115,16 @@ class LlamaBlock(nn.Module):
             new_cache = None
         x = x + attn_out
         h = RMSNorm(name="ffn_norm")(x)
-        x = x + SwiGLU(cfg.hidden_dim, dtype=dtype, name="feed_forward")(h)
+        if cfg.n_experts > 0:
+            from music_analyst_tpu.models.moe import MoESwiGLU
+
+            ffn = MoESwiGLU(
+                cfg.n_experts, cfg.hidden_dim, top_k=cfg.moe_top_k,
+                dtype=dtype, name="feed_forward_moe",
+            )
+        else:
+            ffn = SwiGLU(cfg.hidden_dim, dtype=dtype, name="feed_forward")
+        x = x + ffn(h)
         return x, new_cache
 
 

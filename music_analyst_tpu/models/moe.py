@@ -1,0 +1,70 @@
+"""Mixture-of-experts feed-forward with expert-parallel sharding.
+
+No reference analogue (SURVEY.md §2.4 marks EP absent); present because the
+framework treats every parallelism axis as first-class.  The expert weight
+stacks carry a leading ``E`` axis sharded over the ``ep`` mesh axis
+(``parallel/sharding.py``); the hidden axis additionally shards over ``tp``.
+
+Dispatch is *dense* in this round: every expert computes every token and a
+top-k-masked router combine zeroes the unused results.  That is exact (same
+math as sparse dispatch), keeps shapes static, and shards cleanly; the
+sort/scatter token-dropping dispatch is a later optimization, not a
+semantics change.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MoESwiGLU(nn.Module):
+    """Top-k routed mixture of SwiGLU experts."""
+
+    n_experts: int
+    hidden_dim: int
+    top_k: int = 2
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        features = x.shape[-1]
+        E, H = self.n_experts, self.hidden_dim
+        k = min(self.top_k, E)
+        init = nn.initializers.lecun_normal()
+        gate_w = self.param("gate_experts", init, (E, features, H))
+        up_w = self.param("up_experts", init, (E, features, H))
+        down_w = self.param("down_experts", init, (E, H, features))
+
+        router_logits = nn.Dense(
+            E, use_bias=False, dtype=jnp.float32, name="router"
+        )(x)                                                   # [B,S,E]
+        top_vals, top_idx = jax.lax.top_k(router_logits, k)
+        top_weights = jax.nn.softmax(top_vals, axis=-1)        # [B,S,k]
+        # scatter the top-k weights back to a dense [B,S,E] combine matrix
+        combine = jnp.sum(
+            jax.nn.one_hot(top_idx, E, dtype=jnp.float32)
+            * top_weights[..., None],
+            axis=-2,
+        )
+
+        xc = x.astype(self.dtype)
+        gate = jnp.einsum("bsd,edh->besh", xc, gate_w.astype(self.dtype))
+        up = jnp.einsum("bsd,edh->besh", xc, up_w.astype(self.dtype))
+        expert_out = jnp.einsum(
+            "besh,ehd->besd", nn.silu(gate) * up, down_w.astype(self.dtype)
+        )                                                      # [B,E,S,D]
+        out = jnp.einsum(
+            "bse,besd->bsd", combine.astype(self.dtype), expert_out
+        )
+        return out.astype(x.dtype)
+
+    @staticmethod
+    def load_balancing_loss(router_logits: jax.Array, top_idx: jax.Array,
+                            n_experts: int) -> jax.Array:
+        """Switch-style auxiliary loss (mean prob × mean dispatch per expert)."""
+        probs = jax.nn.softmax(router_logits, axis=-1)
+        mean_prob = probs.mean(axis=(0, 1))
+        dispatch = jax.nn.one_hot(top_idx[..., 0], n_experts).mean(axis=(0, 1))
+        return n_experts * jnp.sum(mean_prob * dispatch)

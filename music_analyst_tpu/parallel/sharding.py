@@ -27,6 +27,10 @@ TP_RULES: List[Tuple[str, P]] = [
     # gated MLP: [dim, hidden] / [hidden, dim]
     (r".*(gate_proj|up_proj)/kernel$", P(None, "tp")),
     (r".*down_proj/kernel$", P("tp", None)),
+    # MoE expert stacks: [E, dim, hidden] / [E, hidden, dim] — expert axis
+    # over ep, hidden over tp; router replicated (matches no rule)
+    (r".*(gate_experts|up_experts)$", P("ep", None, "tp")),
+    (r".*down_experts$", P("ep", "tp", None)),
     # BERT-style MLP
     (r".*ffn/lin1/kernel$", P(None, "tp")),
     (r".*ffn/lin2/kernel$", P("tp", None)),

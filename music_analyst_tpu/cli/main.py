@@ -77,6 +77,18 @@ def _add_split(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--force", action="store_true")
 
 
+def _add_sweep(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "sweep",
+        help="scaling sweep over device counts (run_performance.sh analogue)",
+    )
+    p.add_argument("dataset")
+    p.add_argument("--devices", default=None,
+                   help="Comma-separated device counts (default: 1,2,4,8 capped)")
+    p.add_argument("--output-dir", default="output")
+    p.add_argument("--ingest", choices=("auto", "native", "python"), default="auto")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="music_analyst_tpu",
@@ -87,7 +99,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_sentiment(sub)
     _add_wordcount_per_song(sub)
     _add_split(sub)
+    _add_sweep(sub)
     args = parser.parse_args(argv)
+
+    if args.command == "sweep":
+        from music_analyst_tpu.engines.sweep import run_sweep
+
+        counts = (
+            [int(x) for x in args.devices.split(",")] if args.devices else None
+        )
+        summary = run_sweep(
+            args.dataset,
+            device_counts=counts,
+            output_dir=args.output_dir,
+            ingest_backend=args.ingest,
+            quiet=False,
+        )
+        for run in summary["runs"]:
+            print(
+                f"np={run['devices']}: {run['wall_seconds']}s "
+                f"(speedup {run['speedup_vs_first']}x)"
+            )
+        return 0
 
     if args.command == "analyze":
         from music_analyst_tpu.engines.wordcount import run_analysis

@@ -80,6 +80,20 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ]
         lib.man_free.argtypes = [ctypes.c_void_p]
+        lib.man_hash_tokenize_batch.argtypes = [
+            ctypes.c_char_p,      # blob
+            ctypes.c_void_p,      # offsets int64[n+1]
+            ctypes.c_longlong,    # n_rows
+            ctypes.c_int,         # max_len
+            ctypes.c_int,         # vocab_size
+            ctypes.c_int,         # cls_id
+            ctypes.c_int,         # sep_id
+            ctypes.c_int,         # pad_id
+            ctypes.c_int,         # reserved
+            ctypes.c_int,         # num_threads
+            ctypes.c_void_p,      # out ids
+            ctypes.c_void_p,      # out lens
+        ]
         _lib = lib
         return _lib
 
@@ -91,6 +105,44 @@ def available() -> bool:
 def unavailable_reason() -> str:
     _load()
     return _load_error or "unknown"
+
+
+def hash_tokenize_batch(
+    texts,
+    max_len: int,
+    vocab_size: int,
+    cls_id: int,
+    sep_id: int,
+    pad_id: int,
+    reserved: int,
+    num_threads: int = 0,
+):
+    """C++ batch hash tokenization (spec: models/tokenization.py)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_load_error}")
+    encoded = [t.encode("utf-8", errors="replace") for t in texts]
+    offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
+    np.cumsum([len(e) for e in encoded], out=offsets[1:])
+    blob = b"".join(encoded)
+    n = len(encoded)
+    out = np.empty((n, max_len), dtype=np.int32)
+    lens = np.empty(n, dtype=np.int32)
+    lib.man_hash_tokenize_batch(
+        blob,
+        offsets.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_longlong(n),
+        max_len,
+        vocab_size,
+        cls_id,
+        sep_id,
+        pad_id,
+        reserved,
+        num_threads,
+        out.ctypes.data_as(ctypes.c_void_p),
+        lens.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out, lens
 
 
 def ingest_native(path: str, limit: Optional[int] = None, num_threads: int = 0):

@@ -1,0 +1,65 @@
+"""Checkpoint / resume for training state (Orbax-backed).
+
+The reference has no checkpointing at all — every run recomputes from the
+CSV (SURVEY.md §5 "Checkpoint/resume: none").  Training at framework scale
+needs real save/restore: Orbax handles sharded arrays natively, so a
+TrainState saved from a dp×tp mesh restores onto any mesh with the same
+global shapes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from music_analyst_tpu.engines.train import TrainState
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_train_state(state: TrainState, path: str) -> str:
+    """Save to ``path`` (absolute or cwd-relative); returns the path."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    _checkpointer().save(
+        path,
+        {
+            "params": state.params,
+            "opt_state": state.opt_state,
+            "step": np.asarray(state.step),
+        },
+        force=True,
+    )
+    return path
+
+
+def restore_train_state(
+    path: str,
+    like: Optional[TrainState] = None,
+) -> TrainState:
+    """Restore; with ``like`` given, restores onto its shardings/structure."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    if like is not None:
+        template = {
+            "params": like.params,
+            "opt_state": like.opt_state,
+            "step": np.asarray(like.step),
+        }
+        restored = _checkpointer().restore(path, item=template)
+    else:
+        restored = _checkpointer().restore(path)
+    return TrainState(
+        params=restored["params"],
+        opt_state=restored["opt_state"],
+        step=jax.numpy.asarray(restored["step"]),
+    )

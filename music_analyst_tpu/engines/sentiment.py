@@ -21,7 +21,7 @@ import dataclasses
 import json
 import os
 import time
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from music_analyst_tpu.data.csv_io import iter_songs
 from music_analyst_tpu.utils.labels import SUPPORTED_LABELS
@@ -58,6 +58,17 @@ class ClassifierBackend:
         """Labels for a batch of raw lyric strings."""
         raise NotImplementedError
 
+    # Async pair for host/device pipelining: ``submit`` should do host work
+    # (tokenize) and *dispatch* device work without blocking; ``collect``
+    # blocks on the result.  Device backends override these so the engine
+    # can tokenize batch i+1 while batch i runs on the chips.  The default
+    # is synchronous.
+    def submit(self, texts: Sequence[str]):
+        return self.classify_batch(texts)
+
+    def collect(self, handle) -> List[str]:
+        return handle
+
 
 def get_backend(model: str, mock: bool = False, **kwargs) -> ClassifierBackend:
     """Resolve the ``--model``/``--mock`` flag surface to a backend.
@@ -70,6 +81,11 @@ def get_backend(model: str, mock: bool = False, **kwargs) -> ClassifierBackend:
         from music_analyst_tpu.models.mock import MockKeywordClassifier
 
         return MockKeywordClassifier(**kwargs)
+    if model.startswith("ollama:") or model == "ollama":
+        from music_analyst_tpu.models.ollama import OllamaClassifier
+
+        tag = model.split(":", 1)[1] if ":" in model else "llama3"
+        return OllamaClassifier(model=tag, **kwargs)
     try:
         if model.startswith("distilbert"):
             from music_analyst_tpu.models.distilbert import DistilBertClassifier
@@ -110,30 +126,51 @@ def run_sentiment(
     start = time.perf_counter()
 
     batch: List[Tuple[str, str, str]] = []
+    # One-deep pipeline: while batch i runs on device, batch i+1 tokenizes
+    # on the host (the reference is strictly serial, one HTTP call per song,
+    # SURVEY.md §3.2).
+    in_flight: Optional[Tuple[List[Tuple[str, str, str]], Any, float]] = None
+
+    def finish(rows_batch, handle, t_submit) -> None:
+        labels = clf.collect(handle)
+        elapsed = time.perf_counter() - t_submit
+        # Per-song latency: exact when the backend measures it (Ollama
+        # passthrough), amortized batch time for device backends, 0.0 for
+        # mock — matching the reference's per-row semantics.
+        measured = getattr(clf, "last_latencies", None)
+        per_song = (
+            elapsed / max(1, len(rows_batch)) if clf.reports_latency else 0.0
+        )
+        for i, ((artist, song, text), label) in enumerate(
+            zip(rows_batch, labels)
+        ):
+            if measured and len(measured) == len(rows_batch):
+                latency = measured[i]
+            else:
+                latency = 0.0 if not text.strip() else per_song
+            counts[label] += 1
+            rows.append(SentimentRow(artist, song, label, latency))
 
     def flush() -> None:
+        nonlocal in_flight, batch
         if not batch:
             return
         texts = [text for _, _, text in batch]
         t0 = time.perf_counter()
-        labels = clf.classify_batch(texts)
-        elapsed = time.perf_counter() - t0
-        # Amortized per-song device latency for model backends; mock and
-        # empty lyrics record 0.0 exactly like the reference.
-        per_song = (
-            elapsed / max(1, len(batch)) if clf.reports_latency else 0.0
-        )
-        for (artist, song, text), label in zip(batch, labels):
-            latency = 0.0 if not text.strip() else per_song
-            counts[label] += 1
-            rows.append(SentimentRow(artist, song, label, latency))
-        batch.clear()
+        handle = clf.submit(texts)
+        pending = (batch, handle, t0)
+        batch = []
+        if in_flight is not None:
+            finish(*in_flight)
+        in_flight = pending
 
     for artist, song, text in iter_songs(dataset_path, limit=limit):
         batch.append((artist, song, text))
         if len(batch) >= batch_size:
             flush()
     flush()
+    if in_flight is not None:
+        finish(*in_flight)
     wall = time.perf_counter() - start
 
     totals_path = os.path.join(output_dir, "sentiment_totals.json")

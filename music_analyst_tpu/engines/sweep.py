@@ -1,0 +1,73 @@
+"""Scaling-sweep driver (the ``run_performance.sh`` equivalent, fixed).
+
+The reference sweeps ``mpirun -np N`` over process counts but every run
+overwrites ``output/performance_metrics.json``
+(``scripts/run_performance.sh:21-26``, SURVEY.md §3.5) — nothing archives
+per-N results.  This driver sweeps *device counts* over the mesh, archives
+each run's metrics as ``performance_metrics_np{N}.json``, and writes a
+``sweep_summary.json`` with wall-clock and speedup per point.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import List, Optional, Sequence
+
+import jax
+
+from music_analyst_tpu.engines.wordcount import run_analysis
+from music_analyst_tpu.parallel.mesh import data_parallel_mesh
+
+
+def run_sweep(
+    dataset_path: str,
+    device_counts: Optional[Sequence[int]] = None,
+    output_dir: str = "output",
+    ingest_backend: str = "auto",
+    quiet: bool = True,
+) -> dict:
+    os.makedirs(output_dir, exist_ok=True)
+    n_available = len(jax.devices())
+    if device_counts is None:
+        device_counts = [n for n in (1, 2, 4, 8) if n <= n_available]
+    summary: dict = {"dataset": dataset_path, "runs": []}
+    base_wall = None
+    for n in device_counts:
+        if n > n_available:
+            print(f"skipping np={n}: only {n_available} devices")
+            continue
+        mesh = data_parallel_mesh(n)
+        start = time.perf_counter()
+        run_analysis(
+            dataset_path,
+            output_dir=output_dir,
+            mesh=mesh,
+            write_split=(n == device_counts[0]),  # split artifacts once
+            ingest_backend=ingest_backend,
+            quiet=quiet,
+        )
+        wall = time.perf_counter() - start
+        # Archive this point's metrics (the reference overwrites them).
+        src = os.path.join(output_dir, "performance_metrics.json")
+        dst = os.path.join(output_dir, f"performance_metrics_np{n}.json")
+        shutil.copyfile(src, dst)
+        if base_wall is None:
+            base_wall = wall
+        summary["runs"].append(
+            {
+                "devices": n,
+                "wall_seconds": round(wall, 6),
+                "speedup_vs_first": round(base_wall / wall, 3),
+                "metrics_file": os.path.basename(dst),
+            }
+        )
+        if not quiet:
+            print(f"np={n}: {wall:.3f}s")
+    summary_path = os.path.join(output_dir, "sweep_summary.json")
+    with open(summary_path, "w", encoding="utf-8") as fh:
+        json.dump(summary, fh, indent=2)
+        fh.write("\n")
+    return summary

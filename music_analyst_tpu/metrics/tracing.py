@@ -1,0 +1,31 @@
+"""Profiler tracing hooks.
+
+The reference's only observability is wall-clock timestamps (SURVEY.md §5
+"Tracing/profiling: wall-clock only").  Here any engine run can capture a
+full XLA/TPU profiler trace (HLO timelines, per-op device time) viewable in
+TensorBoard/Perfetto, via one context manager.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def maybe_trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """Capture a ``jax.profiler`` trace into ``trace_dir`` when set."""
+    if not trace_dir:
+        yield
+        return
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region that shows up on the profiler timeline."""
+    with jax.profiler.TraceAnnotation(name):
+        yield

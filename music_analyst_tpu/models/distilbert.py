@@ -239,13 +239,18 @@ class DistilBertClassifier(ClassifierBackend):
             lengths = np.pad(lengths, (0, padded - n), constant_values=1)
         return batch, lengths, n
 
-    def classify_batch(self, texts: Sequence[str]) -> List[str]:
+    def submit(self, texts: Sequence[str]):
+        """Tokenize + dispatch without blocking (JAX async dispatch)."""
         token_ids, lengths = self.tokenizer.encode_batch(texts, self.max_len)
         token_ids, lengths, n = self._pad_batch(token_ids, lengths)
         if self._data_sharding is not None:
             token_ids = jax.device_put(token_ids, self._data_sharding)
             lengths = jax.device_put(lengths, self._data_sharding)
         classes, confidence = self._forward(self.params, token_ids, lengths)
+        return texts, classes, confidence, n
+
+    def collect(self, handle) -> List[str]:
+        texts, classes, confidence, n = handle
         classes = np.asarray(classes)[:n]
         confidence = np.asarray(confidence)[:n]
         labels: List[str] = []
@@ -257,3 +262,6 @@ class DistilBertClassifier(ClassifierBackend):
             else:
                 labels.append(self._CLASS_LABELS[int(cls_id)])
         return labels
+
+    def classify_batch(self, texts: Sequence[str]) -> List[str]:
+        return self.collect(self.submit(texts))

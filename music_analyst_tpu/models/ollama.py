@@ -1,0 +1,76 @@
+"""Ollama HTTP passthrough backend — exact parity with the reference's live path.
+
+The TPU-native backends (``mock``/``distilbert``/``llama``) replace the
+per-song HTTP loop, but the original remote path remains available behind
+the same flag surface (``--model ollama:<tag>``) for users migrating from
+the reference: same endpoint contract (``$OLLAMA_ENDPOINT/api/generate``,
+default ``http://localhost:11434``), same prompt template, same 4,000-char
+truncation, same 120 s timeout, same first-token label normalization
+(``scripts/sentiment_classifier.py:32-36,85-108``) — with the empty-response
+``IndexError`` fixed (SURVEY.md §5 contract #5).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Sequence
+
+from music_analyst_tpu.engines.sentiment import ClassifierBackend
+from music_analyst_tpu.models.llama import LYRICS_TRUNCATION, PROMPT_TEMPLATE
+from music_analyst_tpu.utils.labels import normalise_label
+
+DEFAULT_ENDPOINT = "http://localhost:11434"
+
+
+class OllamaClassifier(ClassifierBackend):
+    name = "ollama"
+
+    def __init__(
+        self,
+        model: str = "llama3",
+        endpoint: str | None = None,
+        timeout: float = 120.0,
+    ) -> None:
+        try:
+            import requests  # noqa: F401
+        except ImportError as exc:  # pragma: no cover - env-dependent
+            raise RuntimeError(
+                "The 'requests' package is required for the Ollama backend. "
+                "Install it or use --mock."
+            ) from exc
+        self.model = model
+        self.endpoint = endpoint or os.environ.get(
+            "OLLAMA_ENDPOINT", DEFAULT_ENDPOINT
+        )
+        self.timeout = timeout
+        self.last_latencies: List[float] = []
+
+    def _classify_one(self, lyrics: str) -> tuple[str, float]:
+        import requests
+
+        lyrics = lyrics.strip()
+        if not lyrics:
+            return "Neutral", 0.0  # reference classify() short-circuit
+        payload = {
+            "model": self.model,
+            "prompt": PROMPT_TEMPLATE.format(lyrics=lyrics[:LYRICS_TRUNCATION]),
+            "stream": False,
+        }
+        start = time.perf_counter()
+        response = requests.post(
+            f"{self.endpoint}/api/generate", json=payload, timeout=self.timeout
+        )
+        elapsed = time.perf_counter() - start
+        response.raise_for_status()
+        raw_output = response.json().get("response", "").strip()
+        return normalise_label(raw_output), elapsed
+
+    def classify_batch(self, texts: Sequence[str]) -> List[str]:
+        labels: List[str] = []
+        self.last_latencies = []
+        for text in texts:
+            label, latency = self._classify_one(text)
+            labels.append(label)
+            self.last_latencies.append(latency)
+        return labels

@@ -24,7 +24,16 @@ _WORD_RE = re.compile(r"[a-z0-9']+|[^\sa-z0-9']", re.IGNORECASE)
 
 
 class HashWordTokenizer:
-    """Deterministic word→id hashing into a fixed vocab space."""
+    """Deterministic word→id hashing into a fixed vocab space.
+
+    Tokenization spec (deliberately byte-level so the native C++ fast path
+    in ``native/ingest.cpp`` is exactly equivalent):
+
+    * ASCII A-Z lowercases; words are runs of ``[a-z0-9']`` bytes;
+    * ASCII whitespace separates; any other character — including each
+      multi-byte UTF-8 character — is its own single-character token;
+    * a word's id is ``reserved + FNV-1a(word bytes) % (vocab - reserved)``.
+    """
 
     def __init__(
         self,
@@ -43,15 +52,51 @@ class HashWordTokenizer:
         self.pad_id = pad_id
         self.reserved = min(reserved, vocab_size // 2)
 
-    def _word_id(self, word: str) -> int:
+    def _hash_id(self, data: bytes) -> int:
         h = 2166136261
-        for ch in word.encode("utf-8"):
+        for ch in data:
             h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
         return self.reserved + (h % (self.vocab_size - self.reserved))
 
+    def _token_ids(self, text: str, max_tokens: int) -> List[int]:
+        data = text.encode("utf-8", errors="replace")
+        ids: List[int] = []
+        i, n = 0, len(data)
+        word_start = -1
+        while i < n and len(ids) < max_tokens:
+            b = data[i]
+            if 65 <= b <= 90:
+                b += 32  # ASCII lowercase
+            is_word = (97 <= b <= 122) or (48 <= b <= 57) or b == 0x27
+            if is_word:
+                if word_start < 0:
+                    word_start = i
+                i += 1
+                continue
+            if word_start >= 0:
+                ids.append(self._hash_id(data[word_start:i].lower()))
+                word_start = -1
+                if len(ids) >= max_tokens:
+                    break
+            if b in (0x20, 0x09, 0x0A, 0x0D, 0x0B, 0x0C):
+                i += 1
+                continue
+            # single character token (UTF-8 multi-byte steps as one char)
+            char_len = 1
+            if b >= 0xF0:
+                char_len = 4
+            elif b >= 0xE0:
+                char_len = 3
+            elif b >= 0xC0:
+                char_len = 2
+            ids.append(self._hash_id(data[i : i + char_len]))
+            i += char_len
+        if word_start >= 0 and len(ids) < max_tokens:
+            ids.append(self._hash_id(data[word_start:i].lower()))
+        return ids
+
     def encode(self, text: str, max_len: int) -> Tuple[np.ndarray, int]:
-        words = _WORD_RE.findall(text.lower())[: max_len - 2]
-        ids = [self.cls_id] + [self._word_id(w) for w in words] + [self.sep_id]
+        ids = [self.cls_id] + self._token_ids(text, max_len - 2) + [self.sep_id]
         length = len(ids)
         out = np.full(max_len, self.pad_id, dtype=np.int32)
         out[:length] = ids
@@ -67,6 +112,27 @@ class HashWordTokenizer:
             batch[i] = row
             lengths[i] = n
         return batch, lengths
+
+
+class NativeHashTokenizer(HashWordTokenizer):
+    """C++-accelerated batch encoding with identical output."""
+
+    def encode_batch(
+        self, texts: Sequence[str], max_len: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        from music_analyst_tpu.data import native
+
+        if not native.available():
+            return super().encode_batch(texts, max_len)
+        return native.hash_tokenize_batch(
+            texts,
+            max_len,
+            vocab_size=self.vocab_size,
+            cls_id=self.cls_id,
+            sep_id=self.sep_id,
+            pad_id=self.pad_id,
+            reserved=self.reserved,
+        )
 
 
 class WordPieceTokenizer:
@@ -172,4 +238,4 @@ def resolve_bert_tokenizer(
     path = vocab_path or os.environ.get("MUSICAAL_BERT_VOCAB")
     if path and os.path.exists(path):
         return WordPieceTokenizer(path)
-    return HashWordTokenizer(vocab_size=vocab_size)
+    return NativeHashTokenizer(vocab_size=vocab_size)

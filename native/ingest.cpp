@@ -464,4 +464,117 @@ void man_copy_artist_vocab(void* handle, char* blob, int32_t* lens) {
 
 void man_free(void* handle) { delete (IngestHandle*)handle; }
 
+// ---------------------------------------------------------------------------
+// Batch hash tokenizer for the encoder classifier.
+//
+// Byte-exact with HashWordTokenizer (models/tokenization.py): ASCII
+// lowercase; words = runs of [a-z0-9']; ASCII whitespace separates; any
+// other character (one UTF-8 char, multi-byte included) is a single token;
+// id = reserved + FNV-1a(bytes) % (vocab - reserved).  Rows are processed
+// in parallel worker threads.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+inline uint32_t fnv1a32(const unsigned char* s, size_t n) {
+  uint32_t h = 2166136261u;
+  for (size_t i = 0; i < n; ++i) {
+    h = (h ^ s[i]) * 16777619u;
+  }
+  return h;
+}
+
+struct HashSpec {
+  int32_t vocab_size, cls_id, sep_id, pad_id, reserved;
+  int32_t hash_id(const unsigned char* s, size_t n, unsigned char* scratch)
+      const {
+    // hash the ASCII-lowercased bytes
+    for (size_t i = 0; i < n; ++i) {
+      unsigned char c = s[i];
+      scratch[i] = (c >= 'A' && c <= 'Z') ? (unsigned char)(c + 32) : c;
+    }
+    return reserved + (int32_t)(fnv1a32(scratch, n) %
+                                (uint32_t)(vocab_size - reserved));
+  }
+};
+
+void hash_tokenize_row(const unsigned char* data, size_t n,
+                       const HashSpec& spec, int32_t max_len, int32_t* out,
+                       int32_t* out_len, std::vector<unsigned char>* scratch) {
+  const int32_t max_tokens = max_len - 2;
+  out[0] = spec.cls_id;
+  int32_t ids_emitted = 0;
+  size_t i = 0;
+  size_t word_start = SIZE_MAX;
+  if (scratch->size() < n + 1) scratch->resize(n + 1);
+  while (i < n && ids_emitted < max_tokens) {
+    unsigned char b = data[i];
+    unsigned char lb = (b >= 'A' && b <= 'Z') ? (unsigned char)(b + 32) : b;
+    bool is_word = (lb >= 'a' && lb <= 'z') || (lb >= '0' && lb <= '9') ||
+                   lb == '\'';
+    if (is_word) {
+      if (word_start == SIZE_MAX) word_start = i;
+      ++i;
+      continue;
+    }
+    if (word_start != SIZE_MAX) {
+      out[1 + ids_emitted++] = spec.hash_id(data + word_start, i - word_start,
+                                            scratch->data());
+      word_start = SIZE_MAX;
+      if (ids_emitted >= max_tokens) break;
+    }
+    if (b == ' ' || b == '\t' || b == '\n' || b == '\r' || b == '\v' ||
+        b == '\f') {
+      ++i;
+      continue;
+    }
+    size_t char_len = 1;
+    if (b >= 0xF0) char_len = 4;
+    else if (b >= 0xE0) char_len = 3;
+    else if (b >= 0xC0) char_len = 2;
+    if (i + char_len > n) char_len = n - i;
+    out[1 + ids_emitted++] = spec.hash_id(data + i, char_len, scratch->data());
+    i += char_len;
+  }
+  if (word_start != SIZE_MAX && ids_emitted < max_tokens) {
+    out[1 + ids_emitted++] = spec.hash_id(data + word_start, i - word_start,
+                                          scratch->data());
+  }
+  out[1 + ids_emitted] = spec.sep_id;
+  *out_len = ids_emitted + 2;
+  for (int32_t j = ids_emitted + 2; j < max_len; ++j) out[j] = spec.pad_id;
+}
+
+}  // namespace
+
+// texts: concatenated UTF-8 blob; offsets: int64[n_rows+1]; out int32
+// [n_rows, max_len]; out_lens int32 [n_rows].
+void man_hash_tokenize_batch(const char* blob, const long long* offsets,
+                             long long n_rows, int max_len, int vocab_size,
+                             int cls_id, int sep_id, int pad_id, int reserved,
+                             int num_threads, int32_t* out,
+                             int32_t* out_lens) {
+  HashSpec spec{vocab_size, cls_id, sep_id, pad_id, reserved};
+  unsigned threads = num_threads > 0
+                         ? (unsigned)num_threads
+                         : std::max(4u, std::thread::hardware_concurrency());
+  if ((long long)threads > n_rows) threads = n_rows > 0 ? (unsigned)n_rows : 1;
+  std::vector<std::thread> pool;
+  long long per = n_rows / threads + 1;
+  for (unsigned t = 0; t < threads; ++t) {
+    long long rb = std::min((long long)t * per, n_rows);
+    long long re = std::min(rb + per, n_rows);
+    pool.emplace_back([=]() {
+      std::vector<unsigned char> scratch(256);
+      for (long long r = rb; r < re; ++r) {
+        hash_tokenize_row(
+            (const unsigned char*)blob + offsets[r],
+            (size_t)(offsets[r + 1] - offsets[r]), spec, max_len,
+            out + r * max_len, out_lens + r, &scratch);
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
 }  // extern "C"

@@ -1,0 +1,120 @@
+"""Pallas TPU kernel for the keyword-sentiment scan.
+
+The XLA formulation (``ops/keyword_sentiment.py``) emits ~10 shifted
+compare/AND/OR chains over the byte matrix; XLA fuses them, but each
+keyword's chain re-reads the block from HBM unless the fusion heuristics
+cooperate.  This kernel makes the locality explicit: one row-block of
+lyrics bytes is staged into VMEM once, lowercased once, and all ten
+keyword scans plus the score combine run out of that single staging —
+one HBM pass total, VPU-only work.
+
+Grid: one program per row block (rows sized to the VMEM budget); the full
+byte length ``L`` (multiple of 128 lanes) sits in the lane dimension.
+Output is the int32 score broadcast across a 128-lane row (TPU-friendly 2D
+output); the host wrapper slices lane 0.
+
+Measured on v5e (8192×2048 bytes): 33.4k songs/s vs 36.6k for the XLA
+formulation — XLA's fusion already keeps this op in one HBM pass, so the
+kernel is kept as the validated hand-scheduled alternative (and the
+template for ops XLA fuses less well), not as the default path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from music_analyst_tpu.ops.keyword_sentiment import (
+    NEGATIVE_KEYWORDS,
+    POSITIVE_KEYWORDS,
+)
+
+def _tile_rows(length: int) -> int:
+    """Rows per grid step, sized to the ~16 MB VMEM budget.
+
+    Mosaic's allocator reports ~70 bytes of scoped VMEM per input lyric
+    byte at this kernel's live-range (widened i32 copy + the shifted
+    compare masks kept live across the keyword chains).  Keep the sublane
+    count a multiple of 32 (int8 tiling) with a floor of 32 rows.
+    """
+    budget = 12 * 1024 * 1024
+    rows = budget // (length * 70)
+    rows = max(32, min(256, (rows // 32) * 32))
+    return rows
+
+
+def _keyword_arrays():
+    pos = [np.frombuffer(k.encode(), dtype=np.uint8) for k in POSITIVE_KEYWORDS]
+    neg = [np.frombuffer(k.encode(), dtype=np.uint8) for k in NEGATIVE_KEYWORDS]
+    return pos, neg
+
+
+def _scan_kernel(x_ref, out_ref):
+    # Mosaic vector arithmetic needs >= 16-bit lanes; widen the bytes once.
+    x = x_ref[:].astype(jnp.int32)                 # [TILE_B, L]
+    x = jnp.where((x >= 65) & (x <= 90), x + 32, x)
+    length = x.shape[1]
+    score = jnp.zeros((x.shape[0],), jnp.int32)
+    pos, neg = _keyword_arrays()
+    for sign, keywords in ((1, pos), (-1, neg)):
+        for kw in keywords:
+            m = int(kw.shape[0])
+            window = length - m + 1
+            acc = x[:, 0:window] == kw[0]
+            for j in range(1, m):
+                acc = acc & (x[:, j : window + j] == kw[j])
+            hit = jnp.any(acc, axis=1)
+            score = score + sign * hit.astype(jnp.int32)
+    out_ref[:] = jnp.broadcast_to(score[:, None], (x.shape[0], 128))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "tile_b"))
+def _pallas_scores(
+    batch: jax.Array, tile_b: int, interpret: bool = False
+) -> jax.Array:
+    B, L = batch.shape
+    grid = (B // tile_b,)
+    return pl.pallas_call(
+        _scan_kernel,
+        out_shape=jax.ShapeDtypeStruct((B, 128), jnp.int32),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (tile_b, L),
+                    lambda i: (i, 0),
+                    memory_space=pltpu.VMEM,
+                )
+            ],
+            out_specs=pl.BlockSpec(
+                (tile_b, 128),
+                lambda i: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ),
+        interpret=interpret,
+    )(batch)
+
+
+def keyword_scores_pallas(batch: np.ndarray) -> np.ndarray:
+    """Scores for a padded uint8 batch ``[B, L]``; pads B to the tile size.
+
+    ``L`` must be a multiple of 128 (the encoder's window sizes are).
+    Falls back to interpreter mode off-TPU so tests exercise the same
+    kernel logic on the CPU mesh.
+    """
+    B, L = batch.shape
+    if L % 128 != 0:
+        raise ValueError(f"byte length {L} must be a multiple of 128")
+    tile_b = _tile_rows(L)
+    padded_b = -(-B // tile_b) * tile_b
+    if padded_b != B:
+        batch = np.pad(batch, ((0, padded_b - B), (0, 0)))
+    interpret = jax.default_backend() != "tpu"
+    out = _pallas_scores(jnp.asarray(batch), tile_b, interpret=interpret)
+    return np.asarray(out[:B, 0])

@@ -1,0 +1,35 @@
+"""Pallas keyword kernel (interpret mode on CPU) vs the XLA kernel."""
+
+import numpy as np
+
+from music_analyst_tpu.ops.keyword_sentiment import encode_batch, keyword_scores
+from music_analyst_tpu.ops.pallas_keyword import keyword_scores_pallas
+
+
+def test_matches_xla_kernel():
+    texts = [
+        "I love sunshine and smiles",
+        "cry me a river of tears",
+        "LOVE and PAIN in equal measure",
+        "nothing to see here",
+        "",
+        "lovely day with sad news",
+    ]
+    batch, overflow = encode_batch(texts, 256)
+    assert not overflow
+    want = np.asarray(keyword_scores(batch))
+    got = keyword_scores_pallas(batch)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_non_tile_batch_padding():
+    rng = np.random.default_rng(0)
+    words = ["love", "tears", "night", "dance", "sad"]
+    texts = [
+        " ".join(rng.choice(words, size=rng.integers(1, 12)))
+        for _ in range(300)  # not a multiple of TILE_B
+    ]
+    batch, _ = encode_batch(texts, 128)
+    want = np.asarray(keyword_scores(batch))
+    got = keyword_scores_pallas(batch)
+    np.testing.assert_array_equal(got, want)

@@ -1,0 +1,137 @@
+"""Pipeline parallelism: stacked stages == sequential model, grads flow."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from music_analyst_tpu.models.layers import causal_mask
+from music_analyst_tpu.models.llama import LlamaBlock, LlamaConfig
+from music_analyst_tpu.parallel.mesh import MeshSpec, build_mesh
+from music_analyst_tpu.parallel.pipeline import (
+    pipeline_apply,
+    stack_layer_params,
+    unstack_layer_params,
+)
+
+
+@pytest.fixture(scope="module")
+def pp_mesh():
+    return build_mesh(MeshSpec((("pp", 4),)), devices=jax.devices()[:4])
+
+
+def test_stack_unstack_roundtrip():
+    params = {
+        f"layer_{i}": {"w": jnp.full((2, 3), float(i))} for i in range(8)
+    }
+    stacked, n_layers = stack_layer_params(params, 4)
+    assert n_layers == 8
+    assert stacked["w"].shape == (4, 2, 2, 3)
+    restored = unstack_layer_params(stacked)
+    for i in range(8):
+        np.testing.assert_array_equal(
+            np.asarray(restored[f"layer_{i}"]["w"]),
+            np.asarray(params[f"layer_{i}"]["w"]),
+        )
+
+
+def test_toy_linear_pipeline_matches_sequential(pp_mesh):
+    rng = np.random.default_rng(0)
+    n_stages, k, d = 4, 2, 16
+    weights = rng.normal(size=(n_stages * k, d, d)).astype(np.float32) * 0.1
+    params = {f"layer_{i}": {"w": jnp.asarray(weights[i])} for i in range(8)}
+    stacked, _ = stack_layer_params(params, n_stages)
+
+    def stage_fn(stage_params, x):
+        def layer(x, w):
+            return jnp.tanh(x @ w), None
+
+        out, _ = jax.lax.scan(layer, x, stage_params["w"])
+        return out
+
+    n_micro, mb = 8, 4
+    x = rng.normal(size=(n_micro, mb, d)).astype(np.float32)
+
+    got = np.asarray(pipeline_apply(stage_fn, stacked, jnp.asarray(x), pp_mesh))
+
+    want = x.copy()
+    for i in range(8):
+        want = np.tanh(want @ weights[i])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_llama_blocks_pipeline_matches_sequential(pp_mesh):
+    cfg = LlamaConfig(
+        vocab_size=64, dim=32, n_layers=4, n_heads=4, n_kv_heads=2,
+        hidden_dim=64, rope_theta=1e4, max_seq_len=64,
+    )
+    block = LlamaBlock(cfg)
+    rng = np.random.default_rng(1)
+    S, mb, n_micro = 8, 2, 4
+    x0 = jnp.asarray(rng.normal(size=(mb, S, cfg.dim)), jnp.float32)
+    params = {}
+    key = jax.random.key(0)
+    for i in range(cfg.n_layers):
+        key, sub = jax.random.split(key)
+        params[f"layer_{i}"] = block.init(
+            sub, x0, causal_mask(S, S, 0), jnp.zeros((mb, S), jnp.int32), None
+        )["params"]
+
+    def apply_block(p, x):
+        positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
+        out, _ = block.apply(
+            {"params": p}, x, causal_mask(x.shape[1], x.shape[1], 0),
+            positions, None,
+        )
+        return out
+
+    # sequential reference
+    want = jnp.broadcast_to(x0, (n_micro,) + x0.shape)
+    outs = []
+    for m in range(n_micro):
+        h = want[m]
+        for i in range(cfg.n_layers):
+            h = apply_block(params[f"layer_{i}"], h)
+        outs.append(np.asarray(h))
+    want_np = np.stack(outs)
+
+    stacked, _ = stack_layer_params(params, 4)
+
+    def stage_fn(stage_params, x):
+        def one(x, p):
+            return apply_block(p, x), None
+
+        out, _ = jax.lax.scan(one, x, stage_params)
+        return out
+
+    mbs = jnp.broadcast_to(x0, (n_micro,) + x0.shape)
+    got = np.asarray(pipeline_apply(stage_fn, stacked, mbs, pp_mesh))
+    np.testing.assert_allclose(got, want_np, rtol=2e-3, atol=2e-3)
+
+
+def test_gradients_flow_through_pipeline(pp_mesh):
+    rng = np.random.default_rng(2)
+    d = 8
+    params = {f"layer_{i}": {"w": jnp.asarray(rng.normal(size=(d, d)) * 0.1,
+                                              jnp.float32)} for i in range(4)}
+    stacked, _ = stack_layer_params(params, 4)
+    x = jnp.asarray(rng.normal(size=(4, 2, d)), jnp.float32)
+
+    def stage_fn(sp, h):
+        def layer(h, w):
+            return jnp.tanh(h @ w), None
+
+        out, _ = jax.lax.scan(layer, h, sp["w"])
+        return out
+
+    def loss(stacked_params):
+        out = pipeline_apply(stage_fn, stacked_params, x, pp_mesh)
+        return jnp.sum(out**2)
+
+    grads = jax.grad(loss)(stacked)
+    g = np.asarray(grads["w"])
+    assert g.shape == stacked["w"].shape
+    assert np.isfinite(g).all()
+    assert np.abs(g).sum() > 0  # every stage got a gradient
+    # each stage's grad is nonzero
+    assert all(np.abs(g[s]).sum() > 0 for s in range(4))

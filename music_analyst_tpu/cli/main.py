@@ -36,6 +36,14 @@ def _add_analyze(sub: argparse._SubParsersAction) -> None:
                    help="Skip writing split_columns/ artifacts")
     p.add_argument("--devices", type=int, default=None,
                    help="Use only the first N devices of the mesh")
+    p.add_argument("--with-sentiment", action="store_true",
+                   help="Joint pipeline: also classify sentiment in this run")
+    p.add_argument("--model", default="mock",
+                   help="Sentiment model for --with-sentiment")
+    p.add_argument("--mock", action="store_true",
+                   help="Keyword-kernel sentiment for --with-sentiment")
+    p.add_argument("--batch-size", type=int, default=4096,
+                   help="Sentiment batch size for --with-sentiment")
 
 
 def _add_sentiment(sub: argparse._SubParsersAction) -> None:
@@ -123,10 +131,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     if args.command == "analyze":
-        from music_analyst_tpu.engines.wordcount import run_analysis
         from music_analyst_tpu.parallel.mesh import data_parallel_mesh
 
         mesh = data_parallel_mesh(args.devices) if args.devices else None
+        if args.with_sentiment:
+            from music_analyst_tpu.engines.joint import run_joint
+
+            run_joint(
+                args.dataset,
+                output_dir=args.output_dir,
+                model=args.model,
+                mock=args.mock,
+                word_limit=args.word_limit,
+                artist_limit=args.artist_limit,
+                limit=args.limit,
+                batch_size=args.batch_size,
+                mesh=mesh,
+                write_split=not args.no_split,
+                ingest_backend=args.ingest,
+            )
+            return 0
+        from music_analyst_tpu.engines.wordcount import run_analysis
+
         run_analysis(
             args.dataset,
             output_dir=args.output_dir,

@@ -1,0 +1,98 @@
+"""Joint pipeline: word/artist histogram + sentiment in one run.
+
+BASELINE.json config[4]: "joint word-histogram + sentiment pipeline, full
+1M songs".  The word/artist counts go through the native ingest + sharded
+psum histogram; sentiment batches stream through the classifier backend
+with the host/device pipeline.  One run, all five reference artifacts,
+one metrics file with the combined stage breakdown.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+from music_analyst_tpu.engines.sentiment import SentimentResult, run_sentiment
+from music_analyst_tpu.engines.wordcount import AnalysisResult, run_analysis
+from music_analyst_tpu.metrics.perf import TimeStats, write_performance_metrics
+from music_analyst_tpu.metrics.timer import StageTimer
+
+
+@dataclasses.dataclass
+class JointResult:
+    analysis: AnalysisResult
+    sentiment: SentimentResult
+    songs_per_second: float
+
+
+def run_joint(
+    dataset_path: str,
+    output_dir: str = "output",
+    model: str = "mock",
+    mock: bool = False,
+    word_limit: int = 0,
+    artist_limit: int = 0,
+    limit: Optional[int] = None,
+    batch_size: int = 4096,
+    mesh=None,
+    write_split: bool = True,
+    ingest_backend: str = "auto",
+    quiet: bool = False,
+) -> JointResult:
+    timer = StageTimer()
+    with timer.stage("wordcount"):
+        analysis = run_analysis(
+            dataset_path,
+            output_dir=output_dir,
+            word_limit=word_limit,
+            artist_limit=artist_limit,
+            limit=limit,
+            mesh=mesh,
+            write_split=write_split,
+            ingest_backend=ingest_backend,
+            quiet=quiet,
+        )
+    with timer.stage("sentiment"):
+        sentiment = run_sentiment(
+            dataset_path,
+            model=model,
+            mock=mock,
+            limit=limit,
+            output_dir=output_dir,
+            batch_size=batch_size,
+            quiet=quiet,
+        )
+    total = timer.total("wordcount", "sentiment")
+    songs_per_second = analysis.total_songs / total if total > 0 else 0.0
+
+    # Re-emit the metrics file with the joint stage breakdown layered in.
+    import jax
+
+    devices = (
+        mesh.devices.flatten().tolist() if mesh is not None else jax.devices()
+    )
+    write_performance_metrics(
+        os.path.join(output_dir, "performance_metrics.json"),
+        processes=len(devices),
+        total_songs=analysis.total_songs,
+        total_words=analysis.total_words,
+        compute_time=TimeStats.uniform(total),
+        total_time=TimeStats.uniform(total),
+        per_chip=[
+            {
+                "device": str(d),
+                "platform": d.platform,
+                "compute_seconds": round(total, 6),
+            }
+            for d in devices
+        ],
+        stages={**analysis.timings, "sentiment": timer.seconds["sentiment"]},
+        device_platform=devices[0].platform if devices else "unknown",
+    )
+    if not quiet:
+        print(
+            f"Joint pipeline: {analysis.total_songs} songs in {total:.2f}s "
+            f"({songs_per_second:.0f} songs/s)"
+        )
+    return JointResult(analysis, sentiment, songs_per_second)

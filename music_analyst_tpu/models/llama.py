@@ -271,7 +271,11 @@ class LlamaZeroShotClassifier(ClassifierBackend):
                 )[:, :, 0]
                 idx = jnp.arange(L - 1)[None, :]
                 rest_lp = jnp.where(idx < label_len - 1, rest_lp, 0.0)
-                return first_lp + rest_lp.sum(axis=1)
+                # Length-normalize: summed log-probs otherwise favor the
+                # shortest label ("Neutral" is one byte shorter than the
+                # other two under the byte tokenizer).
+                total = first_lp + rest_lp.sum(axis=1)
+                return total / jnp.maximum(label_len.astype(jnp.float32), 1.0)
 
             scores = jax.vmap(score_one, in_axes=(0, 0), out_axes=1)(
                 label_ids, label_lens

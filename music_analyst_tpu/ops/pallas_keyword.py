@@ -32,6 +32,8 @@ from jax.experimental.pallas import tpu as pltpu
 from music_analyst_tpu.ops.keyword_sentiment import (
     NEGATIVE_KEYWORDS,
     POSITIVE_KEYWORDS,
+    _contains,
+    _lower_ascii,
 )
 
 def _tile_rows(length: int) -> int:
@@ -55,20 +57,15 @@ def _keyword_arrays():
 
 
 def _scan_kernel(x_ref, out_ref):
-    # Mosaic vector arithmetic needs >= 16-bit lanes; widen the bytes once.
-    x = x_ref[:].astype(jnp.int32)                 # [TILE_B, L]
-    x = jnp.where((x >= 65) & (x <= 90), x + 32, x)
-    length = x.shape[1]
+    # Mosaic vector arithmetic needs >= 16-bit lanes; widen the bytes once,
+    # then reuse the XLA formulation's lowercase/containment helpers so the
+    # matching semantics live in exactly one place.
+    x = _lower_ascii(x_ref[:].astype(jnp.int32))   # [TILE_B, L]
     score = jnp.zeros((x.shape[0],), jnp.int32)
     pos, neg = _keyword_arrays()
     for sign, keywords in ((1, pos), (-1, neg)):
         for kw in keywords:
-            m = int(kw.shape[0])
-            window = length - m + 1
-            acc = x[:, 0:window] == kw[0]
-            for j in range(1, m):
-                acc = acc & (x[:, j : window + j] == kw[j])
-            hit = jnp.any(acc, axis=1)
+            hit = _contains(x, kw.astype(np.int32))
             score = score + sign * hit.astype(jnp.int32)
     out_ref[:] = jnp.broadcast_to(score[:, None], (x.shape[0], 128))
 

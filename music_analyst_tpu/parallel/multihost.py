@@ -1,0 +1,54 @@
+"""Multi-host coordination: the Bcast/Barrier analogues.
+
+The reference synchronizes ranks with ``MPI_Bcast`` (split-file names,
+``src/parallel_spotify.c:830-831``) and ``MPI_Barrier`` (``:850,1067``).
+Under single-controller JAX a single host drives every chip, so in-process
+these are no-ops; under multi-controller (one process per host, as on
+multi-host TPU pods) they map onto ``jax.experimental.multihost_utils``.
+Every call here degrades to the trivial behavior when only one process is
+present, so engine code calls them unconditionally.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """Process 0 — the analogue of the reference's rank-0 master role."""
+    return jax.process_index() == 0
+
+
+def broadcast_from_coordinator(value: Any) -> Any:
+    """Broadcast a pytree of host values from process 0 to all processes."""
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(value)
+
+
+def barrier(name: str = "barrier") -> None:
+    """Block until every process reaches this point."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def all_agree(value) -> bool:
+    """Check a host scalar is identical on every process (debug guard)."""
+    if jax.process_count() == 1:
+        return True
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(np.asarray(value))
+    return bool((gathered == gathered[0]).all())

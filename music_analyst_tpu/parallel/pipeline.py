@@ -77,6 +77,12 @@ def pipeline_apply(
     ``[n_micro, mb, ...]`` and is replicated (stage 0 injects from it).
     """
     n_stages = mesh.shape[axis]
+    lead = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if lead != n_stages:
+        raise ValueError(
+            f"stacked params have {lead} stages but mesh axis "
+            f"{axis!r} has {n_stages} devices"
+        )
 
     def body(stage_params, mb):
         # stage_params leaves arrive as [1, k, ...] (this device's stage).

@@ -1,0 +1,107 @@
+/*
+ * Single-rank MPI stub — lets the *unmodified* reference binary
+ * (/root/reference/src/parallel_spotify.c) compile and run without an MPI
+ * installation, so the differential tests can diff this framework's output
+ * against the reference's byte-for-byte.  Covers exactly the MPI surface
+ * the reference uses (SURVEY.md §2.4): Init/Comm_rank/Comm_size/Bcast/
+ * Barrier/Reduce/Send/Recv/Wtime/Abort/Finalize.  With world_size == 1 the
+ * Send/Recv shuffle never executes and every Reduce is a copy.
+ */
+#ifndef MUSICAAL_TEST_MPI_STUB_H
+#define MUSICAAL_TEST_MPI_STUB_H
+
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+typedef int MPI_Comm;
+typedef int MPI_Datatype;
+typedef int MPI_Op;
+typedef struct {
+  int MPI_SOURCE;
+  int MPI_TAG;
+  int MPI_ERROR;
+} MPI_Status;
+
+#define MPI_COMM_WORLD 0
+#define MPI_CHAR 1
+#define MPI_INT 2
+#define MPI_LONG_LONG 3
+#define MPI_DOUBLE 4
+#define MPI_SUM 10
+#define MPI_MAX 11
+#define MPI_MIN 12
+#define MPI_SUCCESS 0
+
+static size_t mpi_stub_sizeof(MPI_Datatype t) {
+  switch (t) {
+    case MPI_CHAR: return 1;
+    case MPI_INT: return sizeof(int);
+    case MPI_LONG_LONG: return sizeof(long long);
+    case MPI_DOUBLE: return sizeof(double);
+    default: return 1;
+  }
+}
+
+static int MPI_Init(int *argc, char ***argv) {
+  (void)argc; (void)argv;
+  return MPI_SUCCESS;
+}
+
+static int MPI_Comm_rank(MPI_Comm comm, int *rank) {
+  (void)comm;
+  *rank = 0;
+  return MPI_SUCCESS;
+}
+
+static int MPI_Comm_size(MPI_Comm comm, int *size) {
+  (void)comm;
+  *size = 1;
+  return MPI_SUCCESS;
+}
+
+static int MPI_Bcast(void *buf, int count, MPI_Datatype t, int root,
+                     MPI_Comm comm) {
+  (void)buf; (void)count; (void)t; (void)root; (void)comm;
+  return MPI_SUCCESS;
+}
+
+static int MPI_Barrier(MPI_Comm comm) {
+  (void)comm;
+  return MPI_SUCCESS;
+}
+
+static int MPI_Reduce(const void *in, void *out, int count, MPI_Datatype t,
+                      MPI_Op op, int root, MPI_Comm comm) {
+  (void)op; (void)root; (void)comm;
+  memcpy(out, in, (size_t)count * mpi_stub_sizeof(t));
+  return MPI_SUCCESS;
+}
+
+static int MPI_Send(const void *buf, int count, MPI_Datatype t, int dest,
+                    int tag, MPI_Comm comm) {
+  (void)buf; (void)count; (void)t; (void)dest; (void)tag; (void)comm;
+  return MPI_SUCCESS; /* unreachable at world_size == 1 */
+}
+
+static int MPI_Recv(void *buf, int count, MPI_Datatype t, int source,
+                    int tag, MPI_Comm comm, MPI_Status *status) {
+  (void)buf; (void)count; (void)t; (void)source; (void)tag; (void)comm;
+  (void)status;
+  return MPI_SUCCESS; /* unreachable at world_size == 1 */
+}
+
+static double MPI_Wtime(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
+}
+
+static int MPI_Abort(MPI_Comm comm, int code) {
+  (void)comm;
+  exit(code);
+}
+
+static int MPI_Finalize(void) { return MPI_SUCCESS; }
+
+#endif /* MUSICAAL_TEST_MPI_STUB_H */

@@ -1,0 +1,53 @@
+"""Joint pipeline: all five artifacts from one run; multihost no-op path."""
+
+import json
+
+from music_analyst_tpu.cli.main import main
+from music_analyst_tpu.engines.joint import run_joint
+
+
+def test_joint_writes_all_artifacts(fixture_csv, tmp_path):
+    result = run_joint(
+        str(fixture_csv), output_dir=str(tmp_path), mock=True, quiet=True
+    )
+    for name in (
+        "word_counts.csv",
+        "top_artists.csv",
+        "sentiment_totals.json",
+        "sentiment_details.csv",
+        "performance_metrics.json",
+    ):
+        assert (tmp_path / name).exists(), name
+    metrics = json.loads((tmp_path / "performance_metrics.json").read_text())
+    assert "sentiment" in metrics["stages"]
+    assert "ingest" in metrics["stages"]
+    assert result.analysis.total_songs == 7
+    assert sum(result.sentiment.counts.values()) == 8  # DictReader rows
+    assert result.songs_per_second > 0
+
+
+def test_joint_via_cli(fixture_csv, tmp_path, capsys):
+    rc = main(
+        [
+            "analyze",
+            str(fixture_csv),
+            "--with-sentiment",
+            "--mock",
+            "--output-dir",
+            str(tmp_path),
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Joint pipeline:" in out
+    assert (tmp_path / "sentiment_totals.json").exists()
+
+
+def test_multihost_single_process_degenerates():
+    from music_analyst_tpu.parallel import multihost
+
+    assert multihost.process_count() == 1
+    assert multihost.is_coordinator()
+    assert multihost.broadcast_from_coordinator({"a": 1}) == {"a": 1}
+    multihost.barrier("test")  # no-op, must not raise
+    assert multihost.all_agree(42)

@@ -1,0 +1,145 @@
+"""Differential tests against the ACTUAL reference binary.
+
+The north-star correctness gate is "reproduce the CPU ``word_counts.csv``
+ranking exactly" (BASELINE.md).  These tests compile the unmodified
+reference source (``/root/reference/src/parallel_spotify.c``) against a
+single-rank MPI stub (``tests/oracle/mpi.h``), run it, and diff this
+framework's artifacts against the reference's **byte-for-byte**.
+"""
+
+import shutil
+import subprocess
+
+import pytest
+
+REFERENCE_SRC = "/root/reference/src/parallel_spotify.c"
+
+
+@pytest.fixture(scope="module")
+def reference_binary(tmp_path_factory):
+    import os
+    import pathlib
+
+    if not os.path.exists(REFERENCE_SRC):
+        pytest.skip("reference source not available")
+    cc = shutil.which("gcc") or shutil.which("cc")
+    if cc is None:
+        pytest.skip("no C compiler")
+    out_dir = tmp_path_factory.mktemp("refbin")
+    binary = out_dir / "parallel_spotify"
+    stub_dir = pathlib.Path(__file__).parent / "oracle"
+    proc = subprocess.run(
+        [
+            cc, "-O2", "-std=gnu11", f"-I{stub_dir}", "-o", str(binary),
+            REFERENCE_SRC,
+        ],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        pytest.skip(f"reference compile failed: {proc.stderr[:400]}")
+    return binary
+
+
+def run_reference(binary, dataset, out_dir):
+    proc = subprocess.run(
+        [str(binary), str(dataset), "--output-dir", str(out_dir)],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[:500]
+    return proc.stdout
+
+
+def run_ours(dataset, out_dir):
+    from music_analyst_tpu.engines.wordcount import run_analysis
+
+    return run_analysis(str(dataset), output_dir=str(out_dir), quiet=True)
+
+
+@pytest.mark.parametrize("ingest_backend", ["python", "native"])
+def test_fixture_byte_parity(
+    reference_binary, fixture_csv, tmp_path, ingest_backend
+):
+    if ingest_backend == "native":
+        from music_analyst_tpu.data import native
+
+        if not native.available():
+            pytest.skip("native lib unavailable")
+    ref_out = tmp_path / "ref"
+    our_out = tmp_path / "ours"
+    stdout = run_reference(reference_binary, fixture_csv, ref_out)
+    from music_analyst_tpu.engines.wordcount import run_analysis
+
+    result = run_analysis(
+        str(fixture_csv),
+        output_dir=str(our_out),
+        quiet=True,
+        ingest_backend=ingest_backend,
+    )
+    assert (
+        (ref_out / "word_counts.csv").read_bytes()
+        == (our_out / "word_counts.csv").read_bytes()
+    )
+    assert (
+        (ref_out / "top_artists.csv").read_bytes()
+        == (our_out / "top_artists.csv").read_bytes()
+    )
+    # console totals agree with the engine's totals
+    assert f"Total songs processed: {result.total_songs}" in stdout
+    assert f"Total words counted: {result.total_words}" in stdout
+    # the split_columns preprocessing artifacts are byte-identical too
+    for name in ("artist.csv", "text.csv"):
+        assert (
+            (ref_out / "split_columns" / name).read_bytes()
+            == (our_out / "split_columns" / name).read_bytes()
+        ), f"split artifact {name} differs"
+
+
+def test_synthetic_corpus_byte_parity(reference_binary, tmp_path):
+    from music_analyst_tpu.data.synthetic import generate_dataset
+
+    dataset = tmp_path / "synthetic.csv"
+    generate_dataset(str(dataset), num_songs=3000, seed=5)
+    ref_out = tmp_path / "ref"
+    our_out = tmp_path / "ours"
+    run_reference(reference_binary, dataset, ref_out)
+    run_ours(dataset, our_out)
+    assert (
+        (ref_out / "word_counts.csv").read_bytes()
+        == (our_out / "word_counts.csv").read_bytes()
+    )
+    assert (
+        (ref_out / "top_artists.csv").read_bytes()
+        == (our_out / "top_artists.csv").read_bytes()
+    )
+
+
+def test_word_limit_parity(reference_binary, fixture_csv, tmp_path):
+    ref_out = tmp_path / "ref"
+    our_out = tmp_path / "ours"
+    proc = subprocess.run(
+        [
+            str(reference_binary), str(fixture_csv),
+            "--word-limit", "5", "--artist-limit", "3",
+            "--output-dir", str(ref_out),
+        ],
+        capture_output=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0
+    from music_analyst_tpu.engines.wordcount import run_analysis
+
+    run_analysis(
+        str(fixture_csv), output_dir=str(our_out), word_limit=5,
+        artist_limit=3, quiet=True,
+    )
+    assert (
+        (ref_out / "word_counts.csv").read_bytes()
+        == (our_out / "word_counts.csv").read_bytes()
+    )
+    assert (
+        (ref_out / "top_artists.csv").read_bytes()
+        == (our_out / "top_artists.csv").read_bytes()
+    )

@@ -80,6 +80,15 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ]
         lib.man_free.argtypes = [ctypes.c_void_p]
+        lib.man_split_columns.restype = ctypes.c_int
+        lib.man_split_columns.argtypes = [
+            ctypes.c_char_p,  # dataset path
+            ctypes.c_char_p,  # artist out path
+            ctypes.c_char_p,  # text out path
+            ctypes.c_char_p,  # artist header label
+            ctypes.c_char_p,  # text header label
+            ctypes.c_int,     # num_threads
+        ]
         lib.man_hash_tokenize_batch.argtypes = [
             ctypes.c_char_p,      # blob
             ctypes.c_void_p,      # offsets int64[n+1]
@@ -143,6 +152,31 @@ def hash_tokenize_batch(
         lens.ctypes.data_as(ctypes.c_void_p),
     )
     return out, lens
+
+
+def split_columns_native(
+    dataset_path: str,
+    artist_path: str,
+    text_path: str,
+    artist_header: str,
+    text_header: str,
+    num_threads: int = 0,
+) -> bool:
+    """C++ column split; returns False when the library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return False
+    rc = lib.man_split_columns(
+        dataset_path.encode("utf-8"),
+        artist_path.encode("utf-8"),
+        text_path.encode("utf-8"),
+        artist_header.encode("utf-8"),
+        text_header.encode("utf-8"),
+        num_threads,
+    )
+    if rc != 1:
+        raise RuntimeError(f"native column split failed for {dataset_path}")
+    return True
 
 
 def ingest_native(path: str, limit: Optional[int] = None, num_threads: int = 0):

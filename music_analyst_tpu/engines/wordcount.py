@@ -33,7 +33,10 @@ from music_analyst_tpu.data.splitter import (
 )
 from music_analyst_tpu.metrics.perf import TimeStats, write_performance_metrics
 from music_analyst_tpu.metrics.timer import StageTimer
-from music_analyst_tpu.ops.histogram import sharded_histogram
+from music_analyst_tpu.ops.histogram import (
+    sharded_histogram,
+    sharded_histogram_hostlocal,
+)
 from music_analyst_tpu.parallel.mesh import data_parallel_mesh
 
 
@@ -56,9 +59,15 @@ def run_analysis(
     mesh=None,
     write_split: bool = True,
     ingest_backend: str = "auto",
+    count_mode: str = "host-shard",
     quiet: bool = False,
 ) -> AnalysisResult:
     """Run the full analysis and write the reference's output artifacts."""
+    from music_analyst_tpu.utils.cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    enable_persistent_compilation_cache()
     timer = StageTimer()
     os.makedirs(output_dir, exist_ok=True)
     split_dir = os.path.join(output_dir, "split_columns")
@@ -84,13 +93,26 @@ def run_analysis(
         mesh = data_parallel_mesh()
 
     with timer.stage("device_compute"):
-        word_counts = sharded_histogram(
-            corpus.word_ids, max(1, len(corpus.word_vocab)), mesh
+        # np.asarray is the synchronization point: block_until_ready is not
+        # reliable on every PJRT plugin, and the engine needs the host
+        # copies anyway.  "host-shard" counts each shard where it was
+        # ingested and psums dense vectors (O(vocab) transfer);
+        # "device-ids" ships the id matrix to HBM and scatter-adds there
+        # (the layout the joint pipeline uses, where lyrics are on-device
+        # anyway).
+        histogram = (
+            sharded_histogram_hostlocal
+            if count_mode == "host-shard"
+            else sharded_histogram
         )
-        artist_counts = sharded_histogram(
-            corpus.artist_ids, max(1, len(corpus.artist_vocab)), mesh
+        word_counts = np.asarray(
+            histogram(corpus.word_ids, max(1, len(corpus.word_vocab)), mesh)
         )
-        jax.block_until_ready((word_counts, artist_counts))
+        artist_counts = np.asarray(
+            histogram(
+                corpus.artist_ids, max(1, len(corpus.artist_vocab)), mesh
+            )
+        )
     # Grand totals are already global on the host (the reference needs an
     # MPI_Reduce only because each rank holds a partial count).
     total_words = corpus.token_count
@@ -98,10 +120,10 @@ def run_analysis(
 
     with timer.stage("aggregate_export"):
         word_entries = sort_count_entries(
-            corpus.word_vocab.counts_to_entries(np.asarray(word_counts))
+            corpus.word_vocab.counts_to_entries(word_counts)
         )
         artist_entries = sort_count_entries(
-            corpus.artist_vocab.counts_to_entries(np.asarray(artist_counts))
+            corpus.artist_vocab.counts_to_entries(artist_counts)
         )
         word_path = os.path.join(output_dir, "word_counts.csv")
         artist_path = os.path.join(output_dir, "top_artists.csv")

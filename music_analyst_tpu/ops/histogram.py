@@ -46,6 +46,22 @@ def shard_pad(values: np.ndarray, shards: int, pad_value: int) -> np.ndarray:
     return out
 
 
+def _bucket(n: int, floor: int) -> int:
+    """Round up to a power of two (≥ ``floor``) so jit shapes are stable
+    across datasets and the compilation cache keeps hitting."""
+    size = floor
+    while size < n:
+        size <<= 1
+    return size
+
+
+def _bucket_linear(n: int, step: int) -> int:
+    """Round up to a multiple of ``step``: bounded shape count with far
+    less padding than power-of-two buckets (padding is transferred to the
+    device, and host→device bandwidth is the wordcount bottleneck)."""
+    return max(step, -(-n // step) * step)
+
+
 def sharded_histogram(
     ids: np.ndarray,
     vocab_size: int,
@@ -58,17 +74,62 @@ def sharded_histogram(
     ``psum`` over ``axis`` produces the replicated global histogram — the
     TPU-native equivalent of the reference's hash-table shuffle + merge
     (SURVEY.md §2.4 key insight).
+
+    Both the id-array length and the vocab size are bucketed to powers of
+    two (padding ids are ignored, excess vocab slots read zero and are
+    sliced off), so different corpora reuse the same compiled program.
     """
-    padded = shard_pad(np.asarray(ids, dtype=np.int32), mesh.shape[axis], PAD_ID)
+    ids = np.asarray(ids, dtype=np.int32)
+    bucket_len = _bucket_linear(ids.shape[0], 1 << 22)
+    padded = np.full((bucket_len,), PAD_ID, dtype=np.int32)
+    padded[: ids.shape[0]] = ids
+    padded = shard_pad(padded, mesh.shape[axis], PAD_ID)
+    padded_vocab = _bucket(vocab_size, 1 << 10)
     fn = jax.jit(
         jax.shard_map(
-            lambda x: jax.lax.psum(token_histogram(x, vocab_size), axis),
+            lambda x: jax.lax.psum(token_histogram(x, padded_vocab), axis),
             mesh=mesh,
             in_specs=P(axis),
             out_specs=P(),
         )
     )
-    return fn(padded)
+    return fn(padded)[:vocab_size]
+
+
+def sharded_histogram_hostlocal(
+    ids: np.ndarray,
+    vocab_size: int,
+    mesh: Mesh,
+    axis: str = "dp",
+) -> np.ndarray:
+    """Histogram with host-local counting and a device ``psum`` merge.
+
+    The locality structure of a multi-host deployment (and of the
+    reference): each shard's ids are counted where they were ingested and
+    only dense count vectors cross to the device for the collective merge.
+    Per-shard transfer is O(vocab), not O(tokens) — the right trade when
+    the token matrix has no other reason to be device-resident (the
+    ``sharded_histogram`` ids-on-device path serves the joint pipeline,
+    where it does).
+    """
+    ids = np.asarray(ids, dtype=np.int32)
+    shards = mesh.shape[axis]
+    padded_vocab = _bucket(vocab_size, 1 << 10)
+    chunks = np.array_split(ids, shards)
+    local = np.zeros((shards, padded_vocab), dtype=np.int32)
+    for i, chunk in enumerate(chunks):
+        valid = chunk[chunk >= 0]
+        if valid.size:
+            local[i] = np.bincount(valid, minlength=padded_vocab)
+    fn = jax.jit(
+        jax.shard_map(
+            lambda h: jax.lax.psum(h[0], axis),
+            mesh=mesh,
+            in_specs=P(axis, None),
+            out_specs=P(),
+        )
+    )
+    return np.asarray(fn(local))[:vocab_size]
 
 
 def sharded_total(values: np.ndarray, mesh: Mesh, axis: str = "dp") -> int:

@@ -1,0 +1,33 @@
+"""Persistent XLA compilation cache.
+
+First-compile latency (~1-2 s per program on v5e, more for big models)
+would otherwise be paid by every fresh process; with the persistent cache
+a cold CLI invocation reuses programs compiled by any earlier run.
+Combined with the power-of-two shape bucketing in ``ops/histogram.py``,
+repeat analyses skip compilation entirely.
+"""
+
+from __future__ import annotations
+
+import os
+
+_enabled = False
+
+
+def enable_persistent_compilation_cache(path: str | None = None) -> None:
+    global _enabled
+    if _enabled:
+        return
+    import jax
+
+    cache_dir = path or os.environ.get(
+        "MUSICAAL_XLA_CACHE", os.path.expanduser("~/.cache/musicaal_xla")
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+        _enabled = True
+    except Exception:
+        # Cache is an optimization only; never fail a run over it.
+        _enabled = True

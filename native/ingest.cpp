@@ -173,6 +173,33 @@ inline bool c_isspace(unsigned char c) {
          c == '\f';
 }
 
+// Trim and keep outer quotes verbatim when present; unescape "" only for
+// unquoted fields — csv_io.clean_field(preserve=True), the splitter's
+// semantics (reference duplicate_field with preserve_outer_quotes=1).
+void clean_field_preserve(const char* s, size_t n, std::string* out) {
+  size_t b = 0, e = n;
+  while (b < e && c_isspace((unsigned char)s[b])) ++b;
+  while (e > b && c_isspace((unsigned char)s[e - 1])) --e;
+  bool quoted = (e - b) >= 2 && s[b] == '"' && s[e - 1] == '"';
+  out->clear();
+  if (quoted) {
+    out->assign(s + b, e - b);
+    return;
+  }
+  for (size_t i = b; i < e; ++i) {
+    if (s[i] == '"' && i + 1 < e && s[i + 1] == '"') {
+      out->push_back('"');
+      ++i;
+    } else {
+      out->push_back(s[i]);
+    }
+  }
+  size_t b2 = 0, e2 = out->size();
+  while (b2 < e2 && c_isspace((unsigned char)(*out)[b2])) ++b2;
+  while (e2 > b2 && c_isspace((unsigned char)(*out)[e2 - 1])) --e2;
+  if (b2 > 0 || e2 < out->size()) *out = out->substr(b2, e2 - b2);
+}
+
 // Trim, unquote, unescape "" — csv_io.clean_field(preserve=False).
 void clean_field(const char* s, size_t n, std::string* out) {
   size_t b = 0, e = n;
@@ -546,6 +573,85 @@ void hash_tokenize_row(const unsigned char* data, size_t n,
 }
 
 }  // namespace
+
+// Dataset column splitter: writes <artist>.csv and <text>.csv with the
+// reference's preserve-quotes semantics (split_dataset_columns in
+// data/splitter.py is the byte-exact oracle).  Single pass over an
+// in-memory copy of the dataset with buffered sequential writes.
+// Returns 1 on success, 0 on I/O failure.
+int man_split_columns(const char* dataset_path, const char* artist_path,
+                      const char* text_path, const char* artist_header,
+                      const char* text_header, int num_threads) {
+  FILE* fp = fopen(dataset_path, "rb");
+  if (!fp) return 0;
+  fseek(fp, 0, SEEK_END);
+  long file_size = ftell(fp);
+  fseek(fp, 0, SEEK_SET);
+  std::string data;
+  data.resize((size_t)file_size);
+  if (file_size > 0 &&
+      fread(&data[0], 1, (size_t)file_size, fp) != (size_t)file_size) {
+    fclose(fp);
+    return 0;
+  }
+  fclose(fp);
+
+  unsigned threads = num_threads > 0
+                         ? (unsigned)num_threads
+                         : std::max(4u, std::thread::hardware_concurrency());
+  std::vector<size_t> ends =
+      find_record_ends(data.data(), data.size(), threads);
+
+  std::string artist_buf, text_buf;
+  artist_buf.reserve(1 << 20);
+  text_buf.reserve(data.size() + (data.size() >> 2));
+  artist_buf.append(*artist_header ? artist_header : "Artists");
+  artist_buf.push_back('\n');
+  text_buf.append(*text_header ? text_header : "Texts");
+  text_buf.push_back('\n');
+
+  std::string artist, text;
+  for (size_t r = 1; r < ends.size(); ++r) {  // record 0 is the header
+    const char* rec = data.data() + (ends[r - 1] + 1);
+    size_t len = ends[r] - ends[r - 1];
+    while (len > 0 && (rec[len - 1] == '\n' || rec[len - 1] == '\r')) --len;
+    if (len == 0) continue;
+    size_t commas = 0, field0_end = SIZE_MAX, text_begin = SIZE_MAX;
+    bool in_q = false;
+    for (size_t i = 0; i < len; ++i) {
+      char c = rec[i];
+      if (c == '"') {
+        if (in_q && i + 1 < len && rec[i + 1] == '"') ++i;
+        else in_q = !in_q;
+      } else if (c == ',' && !in_q) {
+        if (commas == 0) field0_end = i;
+        if (++commas == 3) { text_begin = i + 1; break; }
+      }
+    }
+    if (commas < 3) continue;
+    clean_field_preserve(rec, field0_end, &artist);
+    clean_field_preserve(rec + text_begin, len - text_begin, &text);
+    artist_buf.append(artist);
+    artist_buf.push_back('\n');
+    text_buf.append(text);
+    text_buf.push_back('\n');
+  }
+
+  FILE* af = fopen(artist_path, "wb");
+  FILE* tf = fopen(text_path, "wb");
+  int ok = af && tf;
+  if (af) {
+    ok = ok && fwrite(artist_buf.data(), 1, artist_buf.size(), af) ==
+                   artist_buf.size();
+    fclose(af);
+  }
+  if (tf) {
+    ok = ok && fwrite(text_buf.data(), 1, text_buf.size(), tf) ==
+                   text_buf.size();
+    fclose(tf);
+  }
+  return ok ? 1 : 0;
+}
 
 // texts: concatenated UTF-8 blob; offsets: int64[n_rows+1]; out int32
 // [n_rows, max_len]; out_lens int32 [n_rows].

@@ -86,3 +86,18 @@ def test_multi_axis_mesh_histogram():
     )
     got = np.asarray(fn(ids))
     np.testing.assert_array_equal(got, np.bincount(ids, minlength=10))
+
+
+def test_hostlocal_matches_device_path():
+    rng = np.random.default_rng(3)
+    vocab = 5000
+    ids = rng.integers(0, vocab, size=250_007).astype(np.int32)
+    ids[::97] = -1  # padding ids ignored in both paths
+    mesh = data_parallel_mesh()
+    from music_analyst_tpu.ops.histogram import sharded_histogram_hostlocal
+
+    a = np.asarray(sharded_histogram(ids, vocab, mesh))
+    b = sharded_histogram_hostlocal(ids, vocab, mesh)
+    np.testing.assert_array_equal(a, b)
+    valid = ids[ids >= 0]
+    np.testing.assert_array_equal(b, np.bincount(valid, minlength=vocab))

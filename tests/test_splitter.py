@@ -55,6 +55,26 @@ class TestDatasetSplitter:
         assert b"BadRow" not in content
 
 
+class TestSplitterBackends:
+    def test_native_matches_python_byte_for_byte(self, fixture_csv, tmp_path):
+        from music_analyst_tpu.data import native
+
+        if not native.available():
+            import pytest
+
+            pytest.skip("native lib unavailable")
+        a = split_dataset_columns(
+            str(fixture_csv), str(tmp_path / "py"), "artist", "text",
+            "artist", "text", backend="python",
+        )
+        b = split_dataset_columns(
+            str(fixture_csv), str(tmp_path / "nat"), "artist", "text",
+            "artist", "text", backend="native",
+        )
+        for pa, pb in zip(a, b):
+            assert open(pa, "rb").read() == open(pb, "rb").read()
+
+
 class TestGenericSplitter:
     def test_one_file_per_column(self, fixture_csv, tmp_path):
         out_dir, names = split_csv_columns(

@@ -32,6 +32,10 @@ def _add_analyze(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--limit", type=int, default=None,
                    help="Only process the first N songs")
     p.add_argument("--ingest", choices=("auto", "native", "python"), default="auto")
+    p.add_argument("--count-mode", choices=("host-shard", "device-ids"),
+                   default="host-shard",
+                   help="Histogram layout: psum of host-ingested shards "
+                        "(default) or scatter-add of device-resident ids")
     p.add_argument("--no-split", action="store_true",
                    help="Skip writing split_columns/ artifacts")
     p.add_argument("--devices", type=int, default=None,
@@ -162,6 +166,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             mesh=mesh,
             write_split=not args.no_split,
             ingest_backend=args.ingest,
+            count_mode=args.count_mode,
         )
         return 0
 

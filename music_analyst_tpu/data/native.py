@@ -45,66 +45,79 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _load_error is not None:
             return _lib
         try:
-            if not os.path.exists(_LIB_PATH):
+            # make is dependency-driven: a no-op when the .so is current,
+            # a rebuild when ingest.cpp is newer (stale .so would otherwise
+            # surface as missing symbols below).  A failed make still
+            # falls through to loading a pre-existing library.
+            try:
                 _try_build()
+            except Exception:
+                if not os.path.exists(_LIB_PATH):
+                    raise
             lib = ctypes.CDLL(_LIB_PATH)
-        except Exception as exc:  # missing toolchain, build failure, ...
+            _bind(lib)
+        except Exception as exc:  # missing toolchain, build failure,
+            # stale .so lacking a symbol (AttributeError from _bind), ...
             _load_error = str(exc)
             return None
-        lib.man_ingest.restype = ctypes.c_void_p
-        lib.man_ingest.argtypes = [ctypes.c_char_p, ctypes.c_longlong, ctypes.c_int]
-        lib.man_error.restype = ctypes.c_char_p
-        lib.man_error.argtypes = [ctypes.c_void_p]
-        lib.man_song_count.restype = ctypes.c_longlong
-        lib.man_song_count.argtypes = [ctypes.c_void_p]
-        lib.man_token_count.restype = ctypes.c_longlong
-        lib.man_token_count.argtypes = [ctypes.c_void_p]
-        lib.man_word_vocab_size.restype = ctypes.c_int
-        lib.man_word_vocab_size.argtypes = [ctypes.c_void_p]
-        lib.man_artist_vocab_size.restype = ctypes.c_int
-        lib.man_artist_vocab_size.argtypes = [ctypes.c_void_p]
-        lib.man_word_vocab_bytes.restype = ctypes.c_longlong
-        lib.man_word_vocab_bytes.argtypes = [ctypes.c_void_p]
-        lib.man_artist_vocab_bytes.restype = ctypes.c_longlong
-        lib.man_artist_vocab_bytes.argtypes = [ctypes.c_void_p]
-        lib.man_copy_word_ids.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
-        lib.man_copy_word_offsets.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
-        lib.man_copy_artist_ids.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
-        # Vocab wire format is length-prefixed (concatenated UTF-8 bytes +
-        # an int32 length per token) — artist names may legally contain
-        # newlines, so a delimiter-based format would corrupt the mapping.
-        lib.man_copy_word_vocab.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-        ]
-        lib.man_copy_artist_vocab.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-        ]
-        lib.man_free.argtypes = [ctypes.c_void_p]
-        lib.man_split_columns.restype = ctypes.c_int
-        lib.man_split_columns.argtypes = [
-            ctypes.c_char_p,  # dataset path
-            ctypes.c_char_p,  # artist out path
-            ctypes.c_char_p,  # text out path
-            ctypes.c_char_p,  # artist header label
-            ctypes.c_char_p,  # text header label
-            ctypes.c_int,     # num_threads
-        ]
-        lib.man_hash_tokenize_batch.argtypes = [
-            ctypes.c_char_p,      # blob
-            ctypes.c_void_p,      # offsets int64[n+1]
-            ctypes.c_longlong,    # n_rows
-            ctypes.c_int,         # max_len
-            ctypes.c_int,         # vocab_size
-            ctypes.c_int,         # cls_id
-            ctypes.c_int,         # sep_id
-            ctypes.c_int,         # pad_id
-            ctypes.c_int,         # reserved
-            ctypes.c_int,         # num_threads
-            ctypes.c_void_p,      # out ids
-            ctypes.c_void_p,      # out lens
-        ]
         _lib = lib
         return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    """Declare every exported symbol's signature (raises if one is absent)."""
+    lib.man_ingest.restype = ctypes.c_void_p
+    lib.man_ingest.argtypes = [ctypes.c_char_p, ctypes.c_longlong, ctypes.c_int]
+    lib.man_error.restype = ctypes.c_char_p
+    lib.man_error.argtypes = [ctypes.c_void_p]
+    lib.man_song_count.restype = ctypes.c_longlong
+    lib.man_song_count.argtypes = [ctypes.c_void_p]
+    lib.man_token_count.restype = ctypes.c_longlong
+    lib.man_token_count.argtypes = [ctypes.c_void_p]
+    lib.man_word_vocab_size.restype = ctypes.c_int
+    lib.man_word_vocab_size.argtypes = [ctypes.c_void_p]
+    lib.man_artist_vocab_size.restype = ctypes.c_int
+    lib.man_artist_vocab_size.argtypes = [ctypes.c_void_p]
+    lib.man_word_vocab_bytes.restype = ctypes.c_longlong
+    lib.man_word_vocab_bytes.argtypes = [ctypes.c_void_p]
+    lib.man_artist_vocab_bytes.restype = ctypes.c_longlong
+    lib.man_artist_vocab_bytes.argtypes = [ctypes.c_void_p]
+    lib.man_copy_word_ids.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.man_copy_word_offsets.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.man_copy_artist_ids.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    # Vocab wire format is length-prefixed (concatenated UTF-8 bytes +
+    # an int32 length per token) — artist names may legally contain
+    # newlines, so a delimiter-based format would corrupt the mapping.
+    lib.man_copy_word_vocab.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.man_copy_artist_vocab.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.man_free.argtypes = [ctypes.c_void_p]
+    lib.man_split_columns.restype = ctypes.c_int
+    lib.man_split_columns.argtypes = [
+        ctypes.c_char_p,  # dataset path
+        ctypes.c_char_p,  # artist out path
+        ctypes.c_char_p,  # text out path
+        ctypes.c_char_p,  # artist header label
+        ctypes.c_char_p,  # text header label
+        ctypes.c_int,     # num_threads
+    ]
+    lib.man_hash_tokenize_batch.argtypes = [
+        ctypes.c_char_p,      # blob
+        ctypes.c_void_p,      # offsets int64[n+1]
+        ctypes.c_longlong,    # n_rows
+        ctypes.c_int,         # max_len
+        ctypes.c_int,         # vocab_size
+        ctypes.c_int,         # cls_id
+        ctypes.c_int,         # sep_id
+        ctypes.c_int,         # pad_id
+        ctypes.c_int,         # reserved
+        ctypes.c_int,         # num_threads
+        ctypes.c_void_p,      # out ids
+        ctypes.c_void_p,      # out lens
+    ]
 
 
 def available() -> bool:

@@ -95,11 +95,12 @@ def run_analysis(
     with timer.stage("device_compute"):
         # np.asarray is the synchronization point: block_until_ready is not
         # reliable on every PJRT plugin, and the engine needs the host
-        # copies anyway.  "host-shard" counts each shard where it was
-        # ingested and psums dense vectors (O(vocab) transfer);
-        # "device-ids" ships the id matrix to HBM and scatter-adds there
-        # (the layout the joint pipeline uses, where lyrics are on-device
-        # anyway).
+        # copies anyway.  "host-shard" (default, and the faster layout on
+        # every corpus measured) counts each shard where it was ingested
+        # and psums dense vectors (O(vocab) transfer); "device-ids" ships
+        # the id stream to HBM and scatter-adds there — the right layout
+        # when the ids are already device-resident (selectable via
+        # ``analyze --count-mode``).
         histogram = (
             sharded_histogram_hostlocal
             if count_mode == "host-shard"

@@ -118,23 +118,36 @@ struct ChunkScan {
   std::vector<size_t> newlines_odd;
 };
 
+// Record terminators are unquoted '\n' OR lone '\r' (the oracle's
+// iter_csv_records_exact).  Emitting a terminator at BOTH bytes of a
+// "\r\n" pair is deliberate: the extra record is the lone "\n", which every
+// consumer drops as blank, and the preceding record's content is identical
+// after terminator trimming — so no pair-straddles-chunk logic is needed.
 void scan_chunk(const char* data, size_t begin, size_t end, ChunkScan* out) {
-  size_t pos = begin;
+  auto next_at = [&](char c, size_t from) -> size_t {
+    if (from >= end) return SIZE_MAX;
+    const char* p = (const char*)memchr(data + from, c, end - from);
+    return p ? (size_t)(p - data) : SIZE_MAX;
+  };
+  size_t qp = next_at('"', begin);
+  size_t np = next_at('\n', begin);
+  size_t cp = next_at('\r', begin);
   bool odd = false;  // local parity within the chunk
-  while (pos < end) {
-    const char* q = (const char*)memchr(data + pos, '"', end - pos);
-    const char* nl = (const char*)memchr(data + pos, '\n', end - pos);
-    if (!q && !nl) break;
-    size_t qp = q ? (size_t)(q - data) : SIZE_MAX;
-    size_t np = nl ? (size_t)(nl - data) : SIZE_MAX;
-    if (np < qp) {
-      (odd ? out->newlines_odd : out->newlines_even).push_back(np);
-      pos = np + 1;
+  while (true) {
+    size_t tp = np < cp ? np : cp;  // nearest terminator candidate
+    if (qp == SIZE_MAX && tp == SIZE_MAX) break;
+    size_t pos;
+    if (tp < qp) {
+      (odd ? out->newlines_odd : out->newlines_even).push_back(tp);
+      pos = tp + 1;
     } else {
       odd = !odd;
       out->quote_count++;
       pos = qp + 1;
     }
+    if (qp < pos) qp = next_at('"', pos);
+    if (np < pos) np = next_at('\n', pos);
+    if (cp < pos) cp = next_at('\r', pos);
   }
 }
 
@@ -173,41 +186,22 @@ inline bool c_isspace(unsigned char c) {
          c == '\f';
 }
 
-// Trim and keep outer quotes verbatim when present; unescape "" only for
-// unquoted fields — csv_io.clean_field(preserve=True), the splitter's
-// semantics (reference duplicate_field with preserve_outer_quotes=1).
-void clean_field_preserve(const char* s, size_t n, std::string* out) {
+// Trim, unquote (or keep outer quotes verbatim), unescape "" —
+// csv_io.clean_field(raw, preserve_outer_quotes).  The preserve form is the
+// splitter's semantics (reference duplicate_field with
+// preserve_outer_quotes=1).
+void clean_field(const char* s, size_t n, bool preserve_outer_quotes,
+                 std::string* out) {
   size_t b = 0, e = n;
   while (b < e && c_isspace((unsigned char)s[b])) ++b;
   while (e > b && c_isspace((unsigned char)s[e - 1])) --e;
   bool quoted = (e - b) >= 2 && s[b] == '"' && s[e - 1] == '"';
   out->clear();
   if (quoted) {
-    out->assign(s + b, e - b);
-    return;
-  }
-  for (size_t i = b; i < e; ++i) {
-    if (s[i] == '"' && i + 1 < e && s[i + 1] == '"') {
-      out->push_back('"');
-      ++i;
-    } else {
-      out->push_back(s[i]);
+    if (preserve_outer_quotes) {
+      out->assign(s + b, e - b);
+      return;
     }
-  }
-  size_t b2 = 0, e2 = out->size();
-  while (b2 < e2 && c_isspace((unsigned char)(*out)[b2])) ++b2;
-  while (e2 > b2 && c_isspace((unsigned char)(*out)[e2 - 1])) --e2;
-  if (b2 > 0 || e2 < out->size()) *out = out->substr(b2, e2 - b2);
-}
-
-// Trim, unquote, unescape "" — csv_io.clean_field(preserve=False).
-void clean_field(const char* s, size_t n, std::string* out) {
-  size_t b = 0, e = n;
-  while (b < e && c_isspace((unsigned char)s[b])) ++b;
-  while (e > b && c_isspace((unsigned char)s[e - 1])) --e;
-  bool quoted = (e - b) >= 2 && s[b] == '"' && s[e - 1] == '"';
-  out->clear();
-  if (quoted) {
     ++b;
     --e;
   }
@@ -281,8 +275,8 @@ void process_records(const char* data, const std::vector<size_t>& starts,
     }
     if (commas < 3) continue;  // reference rejects short records
 
-    clean_field(rec, field0_end, &artist);
-    clean_field(rec + text_begin, len - text_begin, &text);
+    clean_field(rec, field0_end, false, &artist);
+    clean_field(rec + text_begin, len - text_begin, false, &text);
 
     // Tokenize (tokenizer.tokenize_ascii semantics: runs of
     // [0-9A-Za-z'], >= 3 bytes, ASCII-lowercased).
@@ -629,8 +623,8 @@ int man_split_columns(const char* dataset_path, const char* artist_path,
       }
     }
     if (commas < 3) continue;
-    clean_field_preserve(rec, field0_end, &artist);
-    clean_field_preserve(rec + text_begin, len - text_begin, &text);
+    clean_field(rec, field0_end, true, &artist);
+    clean_field(rec + text_begin, len - text_begin, true, &text);
     artist_buf.append(artist);
     artist_buf.push_back('\n');
     text_buf.append(text);

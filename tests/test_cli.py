@@ -27,6 +27,27 @@ def test_analyze_command(fixture_csv, tmp_path, capsys):
     assert (tmp_path / "performance_metrics.json").exists()
 
 
+def test_analyze_count_modes_agree(fixture_csv, tmp_path, capsys):
+    for mode in ("host-shard", "device-ids"):
+        rc = main(
+            [
+                "analyze",
+                str(fixture_csv),
+                "--output-dir",
+                str(tmp_path / mode),
+                "--ingest",
+                "python",
+                "--count-mode",
+                mode,
+            ]
+        )
+        assert rc == 0
+    capsys.readouterr()
+    a = (tmp_path / "host-shard" / "word_counts.csv").read_bytes()
+    b = (tmp_path / "device-ids" / "word_counts.csv").read_bytes()
+    assert a == b
+
+
 def test_sentiment_command_mock(fixture_csv, tmp_path, capsys):
     rc = main(
         [

@@ -116,3 +116,21 @@ def test_crlf_dataset(tmp_path):
     n = native.ingest_native(str(path))
     p = ingest_python(data)
     assert_parity(n, p)
+
+
+def test_lone_cr_dataset(tmp_path):
+    # Classic-Mac lone-\r terminators and an unquoted mid-file \r: the
+    # oracle's record reader (csv_io.iter_csv_records_exact) treats an
+    # unquoted \r exactly like \n; the native boundary scan must agree.
+    path = tmp_path / "cr.csv"
+    data = (
+        b"artist,song,link,text\r"
+        b'A,S1,/l,"hello\rworld line"\r'   # quoted \r is NOT a terminator
+        b"B,S2,/l,short words here\r"
+        b"C,S3,/l,mixed ending row\r\n"
+        b"D,S4,/l,final row words"
+    )
+    path.write_bytes(data)
+    n = native.ingest_native(str(path))
+    p = ingest_python(data)
+    assert_parity(n, p)

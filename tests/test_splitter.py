@@ -74,6 +74,31 @@ class TestSplitterBackends:
         for pa, pb in zip(a, b):
             assert open(pa, "rb").read() == open(pb, "rb").read()
 
+    def test_native_matches_python_lone_cr(self, tmp_path):
+        from music_analyst_tpu.data import native
+
+        if not native.available():
+            import pytest
+
+            pytest.skip("native lib unavailable")
+        src = tmp_path / "cr.csv"
+        src.write_bytes(
+            b"artist,song,link,text\r"
+            b'A,S1,/l,"kept\rinside"\r'
+            b"B,S2,/l,plain text\r\n"
+            b"C,S3,/l,last row"
+        )
+        a = split_dataset_columns(
+            str(src), str(tmp_path / "py"), "artist", "text",
+            "artist", "text", backend="python",
+        )
+        b = split_dataset_columns(
+            str(src), str(tmp_path / "nat"), "artist", "text",
+            "artist", "text", backend="native",
+        )
+        for pa, pb in zip(a, b):
+            assert open(pa, "rb").read() == open(pb, "rb").read()
+
 
 class TestGenericSplitter:
     def test_one_file_per_column(self, fixture_csv, tmp_path):

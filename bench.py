@@ -37,7 +37,7 @@ def main() -> int:
     texts = [text for _, _, text in iter_songs(dataset)]
 
     clf = DistilBertClassifier(max_len=128)
-    batch = 4096
+    batch = 8192  # measured best on v5e: ~10% over 4096 (amortizes dispatch)
 
     # Warmup: compile + first dispatch.
     clf.classify_batch(texts[:batch])

@@ -62,6 +62,9 @@ def _add_sentiment(sub: argparse._SubParsersAction) -> None:
                    help="Keyword-kernel backend (no model weights needed)")
     # TPU-era additions
     p.add_argument("--batch-size", type=int, default=4096)
+    p.add_argument("--resume", action="store_true",
+                   help="Continue from an interrupted run's "
+                        "sentiment_details.csv")
 
 
 def _add_wordcount_per_song(sub: argparse._SubParsersAction) -> None:
@@ -180,6 +183,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             limit=args.limit,
             output_dir=args.output_dir,
             batch_size=args.batch_size,
+            resume=args.resume,
         )
         return 0
 

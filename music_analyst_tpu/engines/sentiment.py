@@ -107,6 +107,30 @@ def get_backend(model: str, mock: bool = False, **kwargs) -> ClassifierBackend:
     )
 
 
+def _read_completed_details(details_path: str) -> Tuple[int, Dict[str, int]]:
+    """Rows already classified in a previous (partial) run + their counts.
+
+    A kill can land mid-write, leaving a torn final line (the writer flushes
+    per batch, but the OS doesn't promise line atomicity).  Truncate the
+    file to its last complete line first, so the torn row is re-classified
+    instead of being counted done and appended onto.
+    """
+    with open(details_path, "rb+") as raw:
+        data = raw.read()
+        if data and not data.endswith(b"\n"):
+            keep = data.rfind(b"\n") + 1
+            raw.truncate(keep)
+    done = 0
+    counts: Dict[str, int] = {label: 0 for label in SUPPORTED_LABELS}
+    with open(details_path, newline="", encoding="utf-8") as fh:
+        for row in csv.DictReader(fh):
+            label = row.get("label", "")
+            if label in counts:
+                counts[label] += 1
+            done += 1
+    return done, counts
+
+
 def run_sentiment(
     dataset_path: str,
     model: str = "mock",
@@ -116,14 +140,38 @@ def run_sentiment(
     batch_size: int = 4096,
     backend: Optional[ClassifierBackend] = None,
     quiet: bool = False,
+    resume: bool = False,
 ) -> SentimentResult:
-    """Classify the dataset and write the reference output artifacts."""
+    """Classify the dataset and write the reference output artifacts.
+
+    Rows stream into ``sentiment_details.csv`` as each batch completes, so a
+    killed run leaves a valid prefix on disk; ``resume=True`` picks up from
+    it (skipping already-classified rows and seeding the totals).  The
+    reference has no recovery at all — every failure recomputes from the CSV
+    (SURVEY.md §5 "Checkpoint/resume: none").
+    """
     os.makedirs(output_dir, exist_ok=True)
     clf = backend if backend is not None else get_backend(model, mock=mock)
 
+    totals_path = os.path.join(output_dir, "sentiment_totals.json")
+    details_path = os.path.join(output_dir, "sentiment_details.csv")
+
+    skip = 0
     counts: Dict[str, int] = {label: 0 for label in SUPPORTED_LABELS}
-    rows: List[SentimentRow] = []
+    if resume and os.path.exists(details_path):
+        skip, counts = _read_completed_details(details_path)
+
+    rows: List[SentimentRow] = []  # rows classified by THIS run
     start = time.perf_counter()
+
+    details_fh = open(
+        details_path, "a" if skip else "w", newline="", encoding="utf-8"
+    )
+    writer = csv.DictWriter(
+        details_fh, fieldnames=["artist", "song", "label", "latency_seconds"]
+    )
+    if not skip:
+        writer.writeheader()
 
     batch: List[Tuple[str, str, str]] = []
     # One-deep pipeline: while batch i runs on device, batch i+1 tokenizes
@@ -150,6 +198,15 @@ def run_sentiment(
                 latency = 0.0 if not text.strip() else per_song
             counts[label] += 1
             rows.append(SentimentRow(artist, song, label, latency))
+            writer.writerow(
+                {
+                    "artist": artist,
+                    "song": song,
+                    "label": label,
+                    "latency_seconds": f"{latency:.4f}",
+                }
+            )
+        details_fh.flush()
 
     def flush() -> None:
         nonlocal in_flight, batch
@@ -164,34 +221,24 @@ def run_sentiment(
             finish(*in_flight)
         in_flight = pending
 
-    for artist, song, text in iter_songs(dataset_path, limit=limit):
-        batch.append((artist, song, text))
-        if len(batch) >= batch_size:
-            flush()
-    flush()
-    if in_flight is not None:
-        finish(*in_flight)
+    try:
+        for idx, (artist, song, text) in enumerate(
+            iter_songs(dataset_path, limit=limit)
+        ):
+            if idx < skip:
+                continue
+            batch.append((artist, song, text))
+            if len(batch) >= batch_size:
+                flush()
+        flush()
+        if in_flight is not None:
+            finish(*in_flight)
+    finally:
+        details_fh.close()
     wall = time.perf_counter() - start
 
-    totals_path = os.path.join(output_dir, "sentiment_totals.json")
     with open(totals_path, "w", encoding="utf-8") as fh:
         json.dump(counts, fh, indent=2)
-
-    details_path = os.path.join(output_dir, "sentiment_details.csv")
-    with open(details_path, "w", newline="", encoding="utf-8") as fh:
-        writer = csv.DictWriter(
-            fh, fieldnames=["artist", "song", "label", "latency_seconds"]
-        )
-        writer.writeheader()
-        writer.writerows(
-            {
-                "artist": r.artist,
-                "song": r.song,
-                "label": r.label,
-                "latency_seconds": f"{r.latency_seconds:.4f}",
-            }
-            for r in rows
-        )
 
     if not quiet:
         print("Sentiment summary:")

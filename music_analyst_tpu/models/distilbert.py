@@ -45,6 +45,9 @@ class DistilBertConfig:
     max_positions: int = 512
     n_classes: int = 2
     dtype: str = "bfloat16"
+    # "flash" = Pallas blocked attention (padding-mask path); max_len must
+    # divide the kernel block size.
+    attn_impl: str = "dense"
 
     @classmethod
     def tiny(cls) -> "DistilBertConfig":
@@ -58,12 +61,13 @@ class TransformerBlock(nn.Module):
     config: DistilBertConfig
 
     @nn.compact
-    def __call__(self, x, mask):
+    def __call__(self, x, mask, lengths=None):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         attn_out = MultiHeadAttention(
-            n_heads=cfg.n_heads, dtype=dtype, name="attention"
-        )(x, mask=mask)
+            n_heads=cfg.n_heads, dtype=dtype, attn_impl=cfg.attn_impl,
+            name="attention",
+        )(x, mask=mask, lengths=lengths)
         x = nn.LayerNorm(name="sa_layer_norm", dtype=dtype)(x + attn_out)
         mlp_out = GeluMLP(cfg.hidden_dim, dtype=dtype, name="ffn")(x)
         return nn.LayerNorm(name="output_layer_norm", dtype=dtype)(x + mlp_out)
@@ -84,7 +88,7 @@ class DistilBertEncoder(nn.Module):
         x = nn.LayerNorm(name="embed_layer_norm", dtype=dtype)(tok + pos)
         mask = padding_mask(lengths, token_ids.shape[1])
         for i in range(cfg.n_layers):
-            x = TransformerBlock(cfg, name=f"layer_{i}")(x, mask)
+            x = TransformerBlock(cfg, name=f"layer_{i}")(x, mask, lengths)
         return x
 
 

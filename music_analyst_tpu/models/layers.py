@@ -146,6 +146,12 @@ class MultiHeadAttention(nn.Module):
     rope_theta: float = 10_000.0
     max_positions: int = 4096
     dtype: jnp.dtype = jnp.bfloat16
+    # "dense" materializes [B,H,S,KV] logits (any mask, any shape);
+    # "flash" runs the Pallas blocked online-softmax kernel
+    # (ops/flash_attention.py) — O(S·D) HBM, causal+lengths masks only,
+    # seq len must divide the kernel block size.
+    attn_impl: str = "dense"
+    flash_causal: bool = False
 
     @nn.compact
     def __call__(
@@ -154,6 +160,7 @@ class MultiHeadAttention(nn.Module):
         mask: Optional[jax.Array] = None,
         positions: Optional[jax.Array] = None,
         cache: Optional[KVCache] = None,
+        lengths: Optional[jax.Array] = None,
     ):
         features = x.shape[-1]
         n_kv = self.n_kv_heads or self.n_heads
@@ -185,7 +192,14 @@ class MultiHeadAttention(nn.Module):
             new_cache = cache.update(k, v)
             k, v = new_cache.keys, new_cache.values
 
-        out = dot_product_attention(q, k, v, mask)
+        if self.attn_impl == "flash" and cache is None:
+            from music_analyst_tpu.ops.flash_attention import flash_attention
+
+            out = flash_attention(
+                q, k, v, lengths=lengths, causal=self.flash_causal
+            )
+        else:
+            out = dot_product_attention(q, k, v, mask)
         out = nn.DenseGeneral(
             features=features,
             axis=(-2, -1),

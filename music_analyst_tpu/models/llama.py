@@ -37,7 +37,10 @@ from music_analyst_tpu.models.layers import (
     causal_mask,
     padding_mask,
 )
-from music_analyst_tpu.models.tokenization import ByteTokenizer
+from music_analyst_tpu.models.tokenization import (
+    ByteTokenizer,
+    resolve_llama_tokenizer,
+)
 from music_analyst_tpu.utils.labels import SUPPORTED_LABELS, normalise_label
 
 # Reference prompt, scripts/sentiment_classifier.py:32-36 (behavioral
@@ -66,6 +69,9 @@ class LlamaConfig:
     # expert axis shards over the ``ep`` mesh axis (models/moe.py).
     n_experts: int = 0
     moe_top_k: int = 2
+    # "flash" uses the Pallas blocked-attention kernel on the no-cache
+    # (prefill/training) path; seq len must divide its block size.
+    attn_impl: str = "dense"
 
     @classmethod
     def llama3_8b(cls) -> "LlamaConfig":
@@ -92,7 +98,8 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, mask, positions, cache: Optional[KVCache]):
+    def __call__(self, x, mask, positions, cache: Optional[KVCache],
+                 lengths: Optional[jax.Array] = None):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         attn = MultiHeadAttention(
@@ -103,6 +110,8 @@ class LlamaBlock(nn.Module):
             rope_theta=cfg.rope_theta,
             max_positions=cfg.max_seq_len,
             dtype=dtype,
+            attn_impl=cfg.attn_impl,
+            flash_causal=True,
             name="attention",
         )
         h = RMSNorm(name="attention_norm")(x)
@@ -111,7 +120,8 @@ class LlamaBlock(nn.Module):
                 h, mask=mask, positions=positions, cache=cache
             )
         else:
-            attn_out = attn(h, mask=mask, positions=positions)
+            attn_out = attn(h, mask=mask, positions=positions,
+                            lengths=lengths)
             new_cache = None
         x = x + attn_out
         h = RMSNorm(name="ffn_norm")(x)
@@ -138,6 +148,7 @@ class LlamaModel(nn.Module):
         positions: jax.Array,                      # [B, S]
         mask: jax.Array,                           # broadcastable [B,H,S,KV]
         caches: Optional[List[KVCache]] = None,
+        lengths: Optional[jax.Array] = None,       # [B] — flash path masks
     ):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
@@ -147,7 +158,7 @@ class LlamaModel(nn.Module):
         for i in range(cfg.n_layers):
             cache_i = caches[i] if caches is not None else None
             x, new_cache = LlamaBlock(cfg, name=f"layer_{i}")(
-                x, mask, positions, cache_i
+                x, mask, positions, cache_i, lengths
             )
             if new_cache is not None:
                 new_caches.append(new_cache)
@@ -167,6 +178,87 @@ def init_caches(
     ]
 
 
+def load_hf_torch_checkpoint(params, path: str):
+    """Map an HF ``LlamaForCausalLM`` torch state_dict onto the Flax params.
+
+    ``path`` is a ``pytorch_model.bin``-style file or a directory of such
+    shards (``pytorch_model*.bin`` / ``*.pt``).  torch Linear kernels
+    ``[out, in]`` transpose to ``[in, out]``; attention projections reshape
+    to ``[dim, heads, head_dim]``.  The RoPE convention needs no weight
+    permutation: HF's ``rotate_half`` splits the head dim into contiguous
+    halves, exactly as ``layers.apply_rope`` does.
+
+    Replaces nothing in the reference — its large-model path is a remote
+    Ollama server (``scripts/sentiment_classifier.py:85-100``); here the
+    weights become first-class on-device arrays.
+    """
+    import torch
+
+    if os.path.isdir(path):
+        shards = sorted(
+            os.path.join(path, f)
+            for f in os.listdir(path)
+            if f.endswith((".bin", ".pt")) and not f.endswith(".index.bin")
+        )
+        if not shards:
+            raise FileNotFoundError(f"no *.bin/*.pt shards under {path}")
+    else:
+        shards = [path]
+    sd = {}
+    for shard in shards:
+        sd.update(torch.load(shard, map_location="cpu", weights_only=True))
+    # Tolerate both bare-model ("model.layers...") and prefixed keys.
+    sd = { (k[len("model."):] if k.startswith("model.") else k): v
+           for k, v in sd.items() }
+
+    def t(name):
+        return np.asarray(sd[name].to(torch.float32).numpy())
+
+    new = jax.tree_util.tree_map(lambda x: x, params)  # shallow copy
+    dim = new["tok_embeddings"]["embedding"].shape[1]
+    embed = t("embed_tokens.weight")
+    want = new["tok_embeddings"]["embedding"].shape
+    if embed.shape != want:
+        raise ValueError(
+            f"checkpoint embed_tokens is {embed.shape} but the model config "
+            f"expects {want} — config (vocab_size/dim) doesn't match the "
+            "checkpoint"
+        )
+    new["tok_embeddings"]["embedding"] = embed
+    n_layers = sum(1 for k in new if k.startswith("layer_"))
+    for i in range(n_layers):
+        hf = f"layers.{i}"
+        layer = new[f"layer_{i}"]
+        attn = layer["attention"]
+        n_heads = attn["q_proj"]["kernel"].shape[1]
+        n_kv = attn["k_proj"]["kernel"].shape[1]
+        head_dim = attn["q_proj"]["kernel"].shape[2]
+        attn["q_proj"]["kernel"] = (
+            t(f"{hf}.self_attn.q_proj.weight").T.reshape(dim, n_heads, head_dim)
+        )
+        attn["k_proj"]["kernel"] = (
+            t(f"{hf}.self_attn.k_proj.weight").T.reshape(dim, n_kv, head_dim)
+        )
+        attn["v_proj"]["kernel"] = (
+            t(f"{hf}.self_attn.v_proj.weight").T.reshape(dim, n_kv, head_dim)
+        )
+        attn["o_proj"]["kernel"] = (
+            t(f"{hf}.self_attn.o_proj.weight").T.reshape(n_heads, head_dim, dim)
+        )
+        layer["attention_norm"]["scale"] = t(f"{hf}.input_layernorm.weight")
+        layer["ffn_norm"]["scale"] = t(f"{hf}.post_attention_layernorm.weight")
+        ffn = layer["feed_forward"]
+        ffn["gate_proj"]["kernel"] = t(f"{hf}.mlp.gate_proj.weight").T
+        ffn["up_proj"]["kernel"] = t(f"{hf}.mlp.up_proj.weight").T
+        ffn["down_proj"]["kernel"] = t(f"{hf}.mlp.down_proj.weight").T
+    new["norm"]["scale"] = t("norm.weight")
+    if "lm_head.weight" in sd:
+        new["lm_head"]["kernel"] = t("lm_head.weight").T
+    else:  # tied embeddings (Llama-3.2 style)
+        new["lm_head"]["kernel"] = t("embed_tokens.weight").T
+    return new
+
+
 class LlamaZeroShotClassifier(ClassifierBackend):
     """Constrained-label zero-shot sentiment over the decoder LM."""
 
@@ -182,7 +274,7 @@ class LlamaZeroShotClassifier(ClassifierBackend):
     ) -> None:
         self.config = config or LlamaConfig.tiny()
         self.max_prompt_len = max_prompt_len
-        self.tokenizer = ByteTokenizer(self.config.vocab_size)
+        self.tokenizer = resolve_llama_tokenizer(self.config.vocab_size)
         self.model = LlamaModel(self.config)
         dummy_ids = jnp.zeros((1, 8), jnp.int32)
         dummy_pos = jnp.zeros((1, 8), jnp.int32)
@@ -192,10 +284,23 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         )["params"]
         self.pretrained = False
         if checkpoint_path:
-            raise NotImplementedError(
-                "Llama checkpoint loading expects an Orbax/flax msgpack dir; "
-                "not available in this environment"
-            )
+            self.params = load_hf_torch_checkpoint(self.params, checkpoint_path)
+            self.pretrained = True
+            if self.tokenizer.vocab_size > self.config.vocab_size:
+                raise ValueError(
+                    f"tokenizer vocab ({self.tokenizer.vocab_size}) exceeds "
+                    f"model vocab ({self.config.vocab_size})"
+                )
+            if isinstance(self.tokenizer, ByteTokenizer):
+                import warnings
+
+                warnings.warn(
+                    "real checkpoint loaded but no matching tokenizer found "
+                    "— byte-level ids won't line up with the checkpoint's "
+                    "BPE vocabulary; set MUSICAAL_LLAMA_TOKENIZER to the "
+                    "checkpoint's tokenizer directory for meaningful labels",
+                    stacklevel=2,
+                )
         self.mesh = mesh
         if mesh is not None:
             from music_analyst_tpu.parallel.sharding import shard_params
@@ -205,14 +310,18 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         # Label continuations are scored teacher-forced after a shared
         # prompt prefill.  All three labels are padded to one fixed length
         # so a single jitted function scores them as a batch dimension.
-        label_rows = [
-            self.tokenizer.encode(label, 16)[0] for label in SUPPORTED_LABELS
-        ]
-        self._label_ids = np.stack(label_rows)[:, 1:9]  # drop BOS, len 8
-        self._label_lens = np.array(
-            [min(len(label.encode()), 8) for label in SUPPORTED_LABELS],
-            dtype=np.int32,
-        )
+        bos_id = getattr(self.tokenizer, "bos_id", None)
+        label_rows, label_lens = [], []
+        for label in SUPPORTED_LABELS:
+            row, n = self.tokenizer.encode(label, 16)
+            # Drop the leading BOS only if this tokenizer actually adds one
+            # (HF tokenizers with add_bos_token=False don't).
+            skip = 1 if (n > 0 and bos_id is not None
+                         and row[0] == bos_id) else 0
+            label_rows.append(row[skip:skip + 8])  # fixed len 8
+            label_lens.append(min(n - skip, 8))
+        self._label_ids = np.stack(label_rows)
+        self._label_lens = np.array(label_lens, dtype=np.int32)
 
         @jax.jit
         def _score_labels(params, prompt_ids, prompt_lens, label_ids,
@@ -367,7 +476,8 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         position = jnp.asarray([int(lens[0])], jnp.int32)
         for _ in range(max_new_tokens):
             out_tokens.append(int(token[0]))
-            if out_tokens[-1] == ByteTokenizer.EOS:
+            if out_tokens[-1] == getattr(self.tokenizer, "eos_id",
+                                         ByteTokenizer.EOS):
                 break
             token, caches = self._decode_step(
                 self.params, token[:, None], position, caches

@@ -31,6 +31,8 @@ class OllamaClassifier(ClassifierBackend):
         model: str = "llama3",
         endpoint: str | None = None,
         timeout: float = 120.0,
+        retries: int | None = None,
+        backoff_seconds: float = 0.5,
     ) -> None:
         try:
             import requests  # noqa: F401
@@ -44,6 +46,13 @@ class OllamaClassifier(ClassifierBackend):
             "OLLAMA_ENDPOINT", DEFAULT_ENDPOINT
         )
         self.timeout = timeout
+        # Transient-failure retries (upgrade over the reference, which
+        # crashes the whole run on the first HTTP error, SURVEY.md §5
+        # "Failure detection: fail-fast only").
+        if retries is None:
+            retries = int(os.environ.get("MUSICAAL_HTTP_RETRIES", "2"))
+        self.retries = max(0, retries)
+        self.backoff_seconds = backoff_seconds
         self.last_latencies: List[float] = []
 
     def _classify_one(self, lyrics: str) -> tuple[str, float]:
@@ -57,14 +66,33 @@ class OllamaClassifier(ClassifierBackend):
             "prompt": PROMPT_TEMPLATE.format(lyrics=lyrics[:LYRICS_TRUNCATION]),
             "stream": False,
         }
-        start = time.perf_counter()
-        response = requests.post(
-            f"{self.endpoint}/api/generate", json=payload, timeout=self.timeout
-        )
-        elapsed = time.perf_counter() - start
-        response.raise_for_status()
-        raw_output = response.json().get("response", "").strip()
-        return normalise_label(raw_output), elapsed
+        last_exc: Exception | None = None
+        for attempt in range(self.retries + 1):
+            start = time.perf_counter()
+            try:
+                response = requests.post(
+                    f"{self.endpoint}/api/generate",
+                    json=payload,
+                    timeout=self.timeout,
+                )
+                elapsed = time.perf_counter() - start
+                response.raise_for_status()
+                raw_output = response.json().get("response", "").strip()
+                return normalise_label(raw_output), elapsed
+            except requests.RequestException as exc:
+                status = getattr(
+                    getattr(exc, "response", None), "status_code", None
+                )
+                # Client errors are not transient — except 408 (request
+                # timeout) and 429 (rate limit), the canonical retryables.
+                if (status is not None and 400 <= status < 500
+                        and status not in (408, 429)):
+                    raise
+                last_exc = exc
+                if attempt < self.retries:
+                    time.sleep(self.backoff_seconds * (2 ** attempt))
+        assert last_exc is not None
+        raise last_exc
 
     def classify_batch(self, texts: Sequence[str]) -> List[str]:
         labels: List[str] = []

@@ -207,6 +207,8 @@ class ByteTokenizer:
         assert vocab_size >= 259
         self.vocab_size = vocab_size
         self.pad_id = self.PAD
+        self.bos_id = self.BOS
+        self.eos_id = self.EOS
 
     def encode(self, text: str, max_len: int) -> Tuple[np.ndarray, int]:
         data = text.encode("utf-8")[: max_len - 1]
@@ -229,6 +231,66 @@ class ByteTokenizer:
     def decode(self, ids: Sequence[int]) -> str:
         data = bytes(i for i in ids if 0 <= i < 256)
         return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizerAdapter:
+    """Wrap a local HF tokenizer (e.g. Llama-3 BPE) behind the same
+    ``encode``/``encode_batch``/``decode`` surface the offline tokenizers
+    expose.  ``local_files_only`` — this environment has zero egress."""
+
+    def __init__(self, path: str) -> None:
+        from transformers import AutoTokenizer
+
+        self.tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.vocab_size = len(self.tok)
+        eos = self.tok.eos_token_id
+        pad = self.tok.pad_token_id
+        self.eos_id = eos if eos is not None else 0
+        self.pad_id = pad if pad is not None else self.eos_id
+        self.bos_id = self.tok.bos_token_id  # may be None (no-BOS styles)
+
+    def encode(self, text: str, max_len: int) -> Tuple[np.ndarray, int]:
+        ids = self.tok.encode(text, truncation=True, max_length=max_len)
+        out = np.full(max_len, self.pad_id, dtype=np.int32)
+        out[: len(ids)] = ids
+        return out, len(ids)
+
+    def encode_batch(
+        self, texts: Sequence[str], max_len: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        # One batched call: fast tokenizers parallelize across texts here;
+        # a per-text Python loop forfeits that on every 4k-song batch.
+        # Padding happens in numpy so tokenizers without a pad token work.
+        ids_list = self.tok(
+            list(texts), truncation=True, max_length=max_len
+        )["input_ids"]
+        batch = np.full((len(texts), max_len), self.pad_id, dtype=np.int32)
+        lengths = np.zeros(len(texts), dtype=np.int32)
+        for i, ids in enumerate(ids_list):
+            batch[i, : len(ids)] = ids
+            lengths[i] = len(ids)
+        return batch, lengths
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self.tok.decode(
+            [int(i) for i in ids if int(i) != self.pad_id],
+            skip_special_tokens=True,
+        )
+
+
+def resolve_llama_tokenizer(
+    vocab_size: int, path: Optional[str] = None
+):
+    """Best-available decoder tokenizer.
+
+    A local HF tokenizer directory (``$MUSICAAL_LLAMA_TOKENIZER``) gives
+    exact Llama-3 BPE for real checkpoints; otherwise the byte tokenizer
+    keeps everything runnable offline.
+    """
+    path = path or os.environ.get("MUSICAAL_LLAMA_TOKENIZER")
+    if path and os.path.exists(path):
+        return HFTokenizerAdapter(path)
+    return ByteTokenizer(vocab_size)
 
 
 def resolve_bert_tokenizer(

@@ -1,0 +1,223 @@
+"""Blocked online-softmax attention as a Pallas TPU kernel.
+
+The dense formulation (``models/layers.py:dot_product_attention``)
+materializes the full ``[B, H, S, KV]`` logit tensor in HBM — fine at the
+classifier's seq 128, quadratic-memory at long context.  This kernel never
+materializes logits: one query block is staged in VMEM, key/value blocks
+stream past it, and the softmax runs online (running max ``m``, running
+denominator ``l``, rescaled accumulator) so HBM traffic is O(S·D) instead
+of O(S²).
+
+Replaces nothing in the reference (its longest "sequence" concern is
+truncating lyrics to 4,000 chars, ``scripts/sentiment_classifier.py:90``);
+this is the long-context path SURVEY.md §5 calls out as the TPU-era
+requirement, and composes with the ring schedule in
+``ops/ring_attention.py`` (each ring hop's local attention is exactly one
+of these kernels).
+
+Grid ``(B, H, q_blocks, kv_blocks)``; the kv dimension is innermost and
+sequential ("arbitrary"), with the running state in VMEM scratch that
+persists across kv steps.  GQA maps query head ``h`` to kv head
+``h // group`` in the BlockSpec index map — no ``jnp.repeat`` of K/V.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(
+    len_ref,  # SMEM [B] — kv valid length per batch row
+    q_ref,    # VMEM [1, 1, bq, D]
+    k_ref,    # VMEM [1, 1, bkv, D]
+    v_ref,    # VMEM [1, 1, bkv, D]
+    o_ref,    # VMEM [1, 1, bq, D]
+    acc_ref,  # scratch f32 [bq, D]
+    m_ref,    # scratch f32 [bq, 128]
+    l_ref,    # scratch f32 [bq, 128]
+    *,
+    causal: bool,
+    block_q: int,
+    block_kv: int,
+    kv_blocks: int,
+    scale: float,
+):
+    bi = pl.program_id(0)
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    kv_len = len_ref[bi]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    if causal:
+        # Skip kv blocks entirely above the diagonal: their every position
+        # is masked, so they can't contribute to the online softmax.
+        run = ki * block_kv <= qi * block_q + block_q - 1
+    else:
+        run = ki >= 0
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bkv]
+
+        kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, dimension=1
+        )
+        valid = kv_pos < kv_len
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, dimension=0
+            )
+            valid = valid & (kv_pos <= q_pos)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]                                  # [bq, 1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        # Guard fully-masked blocks: exp(NEG_INF - NEG_INF) would be 1.
+        p = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_cur), 0.0)
+        alpha = jnp.exp(jnp.minimum(m_prev - m_cur, 0.0))
+        l_cur = alpha * l_prev + p.sum(axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_cur, l_ref.shape)
+
+    @pl.when(ki == kv_blocks - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_kv", "interpret"),
+)
+def _flash_call(
+    q: jax.Array,       # [B, S, H, D]
+    k: jax.Array,       # [B, KV, Hkv, D]
+    v: jax.Array,
+    lengths: jax.Array,  # [B] int32 — valid kv length per row
+    causal: bool,
+    block_q: int,
+    block_kv: int,
+    interpret: bool,
+) -> jax.Array:
+    B, S, H, D = q.shape
+    KV = k.shape[1]
+    Hkv = k.shape[2]
+    # Head-major layout so every VMEM block is (1, 1, seq_block, D): the
+    # sublane/lane dims are then (seq_block, D), which tile cleanly.
+    q = q.transpose(0, 2, 1, 3)  # [B, H, S, D]
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    group = H // Hkv
+    q_blocks = S // block_q
+    kv_blocks = KV // block_kv
+    scale = D ** -0.5
+
+    kernel = functools.partial(
+        _flash_kernel,
+        causal=causal,
+        block_q=block_q,
+        block_kv=block_kv,
+        kv_blocks=kv_blocks,
+        scale=scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        grid=(B, H, q_blocks, kv_blocks),
+        in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths, whole [B]
+                pl.BlockSpec(
+                    (1, 1, block_q, D),
+                    lambda b, h, qi, ki: (b, h, qi, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (1, 1, block_kv, D),
+                    lambda b, h, qi, ki: (b, h // group, ki, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (1, 1, block_kv, D),
+                    lambda b, h, qi, ki: (b, h // group, ki, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, D),
+            lambda b, h, qi, ki: (b, h, qi, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(lengths, q, k, v)
+    return out.transpose(0, 2, 1, 3)  # back to [B, S, H, D]
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    lengths: jax.Array | None = None,
+    causal: bool = False,
+    block_q: int = 512,
+    block_kv: int = 1024,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Attention over ``[B, S, H, D]`` without materializing logits.
+
+    ``lengths`` masks keys/values past each row's valid length (encoder
+    padding); ``causal`` adds the autoregressive mask.  GQA is supported
+    when ``k``/``v`` carry fewer heads.  Sequence lengths must divide into
+    the block sizes; callers pad (the framework's batches are already
+    padded to static shapes).  Off-TPU the kernel runs in interpreter mode
+    so CPU test meshes exercise the same code path.
+    """
+    B, S, H, D = q.shape
+    KV = k.shape[1]
+    block_q = min(block_q, S)
+    block_kv = min(block_kv, KV)
+    if S % block_q or KV % block_kv:
+        raise ValueError(
+            f"seq lengths ({S}, {KV}) must be multiples of the block sizes "
+            f"({block_q}, {block_kv})"
+        )
+    if H % k.shape[2]:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {k.shape[2]}")
+    if lengths is None:
+        lengths = jnp.full((B,), KV, jnp.int32)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_call(
+        q, k, v, lengths.astype(jnp.int32), causal, block_q, block_kv,
+        interpret,
+    )

@@ -1,0 +1,93 @@
+"""Flash attention kernel ≡ dense attention (the model-family invariant)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from music_analyst_tpu.models.layers import (
+    causal_mask,
+    dot_product_attention,
+    padding_mask,
+)
+from music_analyst_tpu.ops.flash_attention import flash_attention
+
+
+def _qkv(key, B, S, H, D, n_kv=None, kv_len=None):
+    n_kv = n_kv or H
+    kv_len = kv_len or S
+    kq, kk, kv = jax.random.split(jax.random.key(key), 3)
+    q = jax.random.normal(kq, (B, S, H, D), jnp.float32)
+    k = jax.random.normal(kk, (B, kv_len, n_kv, D), jnp.float32)
+    v = jax.random.normal(kv, (B, kv_len, n_kv, D), jnp.float32)
+    return q, k, v
+
+
+def test_matches_dense_full_attention():
+    q, k, v = _qkv(0, B=2, S=256, H=4, D=64)
+    out = flash_attention(q, k, v)
+    ref = dot_product_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_matches_dense_causal():
+    q, k, v = _qkv(1, B=2, S=256, H=4, D=64)
+    out = flash_attention(q, k, v, causal=True)
+    ref = dot_product_attention(q, k, v, mask=causal_mask(256, 256, 0))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_matches_dense_padding_lengths():
+    q, k, v = _qkv(2, B=3, S=128, H=2, D=64)
+    lengths = jnp.asarray([128, 70, 1], jnp.int32)
+    out = flash_attention(q, k, v, lengths=lengths)
+    ref = dot_product_attention(q, k, v, mask=padding_mask(lengths, 128))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_gqa_head_grouping():
+    q, k, v = _qkv(3, B=2, S=128, H=8, D=64, n_kv=2)
+    out = flash_attention(q, k, v, causal=True)
+    ref = dot_product_attention(q, k, v, mask=causal_mask(128, 128, 0))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_causal_plus_lengths_compose():
+    q, k, v = _qkv(4, B=2, S=128, H=2, D=64)
+    lengths = jnp.asarray([100, 128], jnp.int32)
+    out = flash_attention(q, k, v, lengths=lengths, causal=True)
+    mask = causal_mask(128, 128, 0) & padding_mask(lengths, 128)
+    ref = dot_product_attention(q, k, v, mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_multiple_kv_blocks_online_softmax():
+    """KV longer than one block exercises the running rescale."""
+    q, k, v = _qkv(5, B=1, S=128, H=2, D=64, kv_len=512)
+    out = flash_attention(q, k, v, block_kv=128)
+    ref = dot_product_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_bf16_io():
+    q, k, v = _qkv(6, B=2, S=128, H=4, D=64)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = flash_attention(q, k, v, causal=True)
+    assert out.dtype == jnp.bfloat16
+    ref = dot_product_attention(q, k, v, mask=causal_mask(128, 128, 0))
+    np.testing.assert_allclose(
+        np.asarray(out, jnp.float32), np.asarray(ref, jnp.float32),
+        atol=3e-2, rtol=3e-2,
+    )
+
+
+def test_rejects_ragged_blocks():
+    q, k, v = _qkv(7, B=1, S=100, H=2, D=64)
+    with pytest.raises(ValueError, match="multiples"):
+        flash_attention(q, k, v, block_q=64, block_kv=64)

@@ -110,15 +110,23 @@ def get_backend(model: str, mock: bool = False, **kwargs) -> ClassifierBackend:
 def _read_completed_details(details_path: str) -> Tuple[int, Dict[str, int]]:
     """Rows already classified in a previous (partial) run + their counts.
 
-    A kill can land mid-write, leaving a torn final line (the writer flushes
+    A kill can land mid-write, leaving a torn final row (the writer flushes
     per batch, but the OS doesn't promise line atomicity).  Truncate the
-    file to its last complete line first, so the torn row is re-classified
-    instead of being counted done and appended onto.
+    file to the last newline at even quote parity — a newline inside an
+    open quoted field (multi-line artist/song) is row *content*, not a row
+    end — so the torn row is re-classified instead of being counted done
+    and appended onto.
     """
     with open(details_path, "rb+") as raw:
         data = raw.read()
-        if data and not data.endswith(b"\n"):
-            keep = data.rfind(b"\n") + 1
+        in_quote = False
+        keep = 0
+        for i, byte in enumerate(data):
+            if byte == 0x22:  # '"' — "" escapes toggle twice, net even
+                in_quote = not in_quote
+            elif byte == 0x0A and not in_quote:
+                keep = i + 1
+        if keep != len(data):
             raw.truncate(keep)
     done = 0
     counts: Dict[str, int] = {label: 0 for label in SUPPORTED_LABELS}
@@ -179,13 +187,12 @@ def run_sentiment(
     # SURVEY.md §3.2).
     in_flight: Optional[Tuple[List[Tuple[str, str, str]], Any, float]] = None
 
-    def finish(rows_batch, handle, t_submit) -> None:
+    def finish(rows_batch, handle, t_submit, measured) -> None:
         labels = clf.collect(handle)
         elapsed = time.perf_counter() - t_submit
         # Per-song latency: exact when the backend measures it (Ollama
         # passthrough), amortized batch time for device backends, 0.0 for
         # mock — matching the reference's per-row semantics.
-        measured = getattr(clf, "last_latencies", None)
         per_song = (
             elapsed / max(1, len(rows_batch)) if clf.reports_latency else 0.0
         )
@@ -215,7 +222,11 @@ def run_sentiment(
         texts = [text for _, _, text in batch]
         t0 = time.perf_counter()
         handle = clf.submit(texts)
-        pending = (batch, handle, t0)
+        # Snapshot measured latencies NOW: synchronous backends (Ollama)
+        # classify inside submit() and overwrite last_latencies on the
+        # next submit, which would mis-attribute them across batches.
+        measured = getattr(clf, "last_latencies", None)
+        pending = (batch, handle, t0, list(measured) if measured else None)
         batch = []
         if in_flight is not None:
             finish(*in_flight)

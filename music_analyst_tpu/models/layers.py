@@ -195,6 +195,15 @@ class MultiHeadAttention(nn.Module):
         if self.attn_impl == "flash" and cache is None:
             from music_analyst_tpu.ops.flash_attention import flash_attention
 
+            # The flash kernel expresses masking ONLY via flash_causal +
+            # lengths; an arbitrary `mask` array can't reach it.  A mask
+            # with neither of those set would be silently dropped — refuse.
+            if mask is not None and lengths is None and not self.flash_causal:
+                raise ValueError(
+                    "attn_impl='flash' ignores the mask argument; pass "
+                    "lengths= (padding) and/or set flash_causal instead, "
+                    "or use attn_impl='dense' for arbitrary masks"
+                )
             out = flash_attention(
                 q, k, v, lengths=lengths, causal=self.flash_causal
             )

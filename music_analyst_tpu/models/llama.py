@@ -195,18 +195,27 @@ def load_hf_torch_checkpoint(params, path: str):
     import torch
 
     if os.path.isdir(path):
-        shards = sorted(
-            os.path.join(path, f)
-            for f in os.listdir(path)
-            if f.endswith((".bin", ".pt")) and not f.endswith(".index.bin")
-        )
+        names = sorted(os.listdir(path))
+        # HF Trainer dirs also hold training_args.bin / optimizer.pt etc.;
+        # prefer the canonical weight-shard names when present.
+        shards = [n for n in names
+                  if n.startswith("pytorch_model") and n.endswith(".bin")]
         if not shards:
-            raise FileNotFoundError(f"no *.bin/*.pt shards under {path}")
+            shards = [n for n in names
+                      if n.endswith((".bin", ".pt"))
+                      and n not in ("training_args.bin", "optimizer.pt",
+                                    "scheduler.pt", "rng_state.pth")]
+        shards = [os.path.join(path, n) for n in shards]
+        if not shards:
+            raise FileNotFoundError(f"no *.bin/*.pt weight shards under {path}")
     else:
         shards = [path]
     sd = {}
     for shard in shards:
-        sd.update(torch.load(shard, map_location="cpu", weights_only=True))
+        loaded = torch.load(shard, map_location="cpu", weights_only=True)
+        if not isinstance(loaded, dict):
+            continue  # not a state_dict (e.g. a stray scalar/args pickle)
+        sd.update(loaded)
     # Tolerate both bare-model ("model.layers...") and prefixed keys.
     sd = { (k[len("model."):] if k.startswith("model.") else k): v
            for k, v in sd.items() }

@@ -36,24 +36,29 @@ NEG_INF = -1e30
 
 def _flash_kernel(
     len_ref,  # SMEM [B] — kv valid length per batch row
+    off_ref,  # SMEM [2] — (q_offset, kv_offset) global position offsets
     q_ref,    # VMEM [1, 1, bq, D]
     k_ref,    # VMEM [1, 1, bkv, D]
     v_ref,    # VMEM [1, 1, bkv, D]
     o_ref,    # VMEM [1, 1, bq, D]
-    acc_ref,  # scratch f32 [bq, D]
-    m_ref,    # scratch f32 [bq, 128]
-    l_ref,    # scratch f32 [bq, 128]
-    *,
+    *rest,    # residuals=True: m_out/l_out [1, 1, bq, 128], then scratch
     causal: bool,
     block_q: int,
     block_kv: int,
     kv_blocks: int,
     scale: float,
+    residuals: bool,
 ):
+    if residuals:
+        m_out_ref, l_out_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        acc_ref, m_ref, l_ref = rest
     bi = pl.program_id(0)
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     kv_len = len_ref[bi]
+    q_off = off_ref[0]
+    kv_off = off_ref[1]
 
     @pl.when(ki == 0)
     def _init():
@@ -62,9 +67,9 @@ def _flash_kernel(
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     if causal:
-        # Skip kv blocks entirely above the diagonal: their every position
-        # is masked, so they can't contribute to the online softmax.
-        run = ki * block_kv <= qi * block_q + block_q - 1
+        # Skip kv blocks whose every (offset-adjusted) position is above
+        # the diagonal: they can't contribute to the online softmax.
+        run = kv_off + ki * block_kv <= q_off + qi * block_q + block_q - 1
     else:
         run = ki >= 0
 
@@ -77,12 +82,12 @@ def _flash_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bq, bkv]
 
-        kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
+        kv_pos = kv_off + ki * block_kv + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, dimension=1
         )
         valid = kv_pos < kv_len
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            q_pos = q_off + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, dimension=0
             )
             valid = valid & (kv_pos <= q_pos)
@@ -103,24 +108,34 @@ def _flash_kernel(
 
     @pl.when(ki == kv_blocks - 1)
     def _finalize():
-        denom = jnp.maximum(l_ref[:, :1], 1e-30)
-        o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        if residuals:
+            # Unnormalized accumulator + running stats: hop-combinable
+            # (ring attention merges partials across devices).
+            o_ref[0, 0] = acc_ref[:].astype(o_ref.dtype)
+            m_out_ref[0, 0] = m_ref[:]
+            l_out_ref[0, 0] = l_ref[:]
+        else:
+            denom = jnp.maximum(l_ref[:, :1], 1e-30)
+            o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "block_q", "block_kv", "interpret"),
+    static_argnames=("causal", "block_q", "block_kv", "interpret",
+                     "residuals"),
 )
 def _flash_call(
     q: jax.Array,       # [B, S, H, D]
     k: jax.Array,       # [B, KV, Hkv, D]
     v: jax.Array,
     lengths: jax.Array,  # [B] int32 — valid kv length per row
+    offsets: jax.Array,  # [2] int32 — (q_offset, kv_offset)
     causal: bool,
     block_q: int,
     block_kv: int,
     interpret: bool,
-) -> jax.Array:
+    residuals: bool,
+):
     B, S, H, D = q.shape
     KV = k.shape[1]
     Hkv = k.shape[2]
@@ -141,34 +156,45 @@ def _flash_call(
         block_kv=block_kv,
         kv_blocks=kv_blocks,
         scale=scale,
+        residuals=residuals,
     )
+    qblock_spec = pl.BlockSpec(
+        (1, 1, block_q, D),
+        lambda b, h, qi, ki: (b, h, qi, 0),
+        memory_space=pltpu.VMEM,
+    )
+    kvblock_spec = pl.BlockSpec(
+        (1, 1, block_kv, D),
+        lambda b, h, qi, ki: (b, h // group, ki, 0),
+        memory_space=pltpu.VMEM,
+    )
+    stat_spec = pl.BlockSpec(
+        (1, 1, block_q, 128),
+        lambda b, h, qi, ki: (b, h, qi, 0),
+        memory_space=pltpu.VMEM,
+    )
+    if residuals:
+        out_shape = (
+            jax.ShapeDtypeStruct((B, H, S, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, S, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, S, 128), jnp.float32),
+        )
+        out_specs = (qblock_spec, stat_spec, stat_spec)
+    else:
+        out_shape = jax.ShapeDtypeStruct((B, H, S, D), q.dtype)
+        out_specs = qblock_spec
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        out_shape=out_shape,
         grid=(B, H, q_blocks, kv_blocks),
         in_specs=[
-                pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths, whole [B]
-                pl.BlockSpec(
-                    (1, 1, block_q, D),
-                    lambda b, h, qi, ki: (b, h, qi, 0),
-                    memory_space=pltpu.VMEM,
-                ),
-                pl.BlockSpec(
-                    (1, 1, block_kv, D),
-                    lambda b, h, qi, ki: (b, h // group, ki, 0),
-                    memory_space=pltpu.VMEM,
-                ),
-                pl.BlockSpec(
-                    (1, 1, block_kv, D),
-                    lambda b, h, qi, ki: (b, h // group, ki, 0),
-                    memory_space=pltpu.VMEM,
-                ),
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths, whole [B]
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # offsets [2]
+            qblock_spec,
+            kvblock_spec,
+            kvblock_spec,
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, D),
-            lambda b, h, qi, ki: (b, h, qi, 0),
-            memory_space=pltpu.VMEM,
-        ),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -179,7 +205,11 @@ def _flash_call(
                                  "arbitrary"),
         ),
         interpret=interpret,
-    )(lengths, q, k, v)
+    )(lengths, offsets, q, k, v)
+    if residuals:
+        o, m, l = out
+        # o unnormalized [B,H,S,D] f32; stats collapse their broadcast lane.
+        return o.transpose(0, 2, 1, 3), m[..., 0], l[..., 0]
     return out.transpose(0, 2, 1, 3)  # back to [B, S, H, D]
 
 
@@ -192,7 +222,10 @@ def flash_attention(
     block_q: int = 512,
     block_kv: int = 1024,
     interpret: bool | None = None,
-) -> jax.Array:
+    q_offset: jax.Array | int = 0,
+    kv_offset: jax.Array | int = 0,
+    return_residuals: bool = False,
+):
     """Attention over ``[B, S, H, D]`` without materializing logits.
 
     ``lengths`` masks keys/values past each row's valid length (encoder
@@ -201,6 +234,13 @@ def flash_attention(
     the block sizes; callers pad (the framework's batches are already
     padded to static shapes).  Off-TPU the kernel runs in interpreter mode
     so CPU test meshes exercise the same code path.
+
+    ``q_offset``/``kv_offset`` shift the global positions used by the
+    causal/length masks — the hook that lets a sequence-parallel caller
+    (ring attention) run this kernel on one K/V shard at a time.  With
+    ``return_residuals=True`` the call returns ``(o_unnormalized, m, l)``
+    (``[B,S,H,D]`` f32, ``[B,H,S]``, ``[B,H,S]``) for cross-shard online
+    combination instead of the normalized output.
     """
     B, S, H, D = q.shape
     KV = k.shape[1]
@@ -214,10 +254,17 @@ def flash_attention(
     if H % k.shape[2]:
         raise ValueError(f"q heads {H} not a multiple of kv heads {k.shape[2]}")
     if lengths is None:
-        lengths = jnp.full((B,), KV, jnp.int32)
+        # Lengths are *global* positions: with a kv_offset the local shard
+        # covers [kv_offset, kv_offset + KV).
+        lengths = jnp.full((B,), KV, jnp.int32) + jnp.asarray(
+            kv_offset, jnp.int32
+        )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    offsets = jnp.stack(
+        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)]
+    )
     return _flash_call(
-        q, k, v, lengths.astype(jnp.int32), causal, block_q, block_kv,
-        interpret,
+        q, k, v, lengths.astype(jnp.int32), offsets, causal, block_q,
+        block_kv, interpret, return_residuals,
     )

@@ -7,6 +7,16 @@ Send/Recv wire protocol (``:396-432``), and the rank-0 sequential merge
 per-chip histogram is one scatter-add and the cross-chip merge is one
 all-reduce over ICI — O(vocab) bytes in a single collective instead of
 O(entries) point-to-point string messages.
+
+Design note — why there is no Pallas histogram kernel: scatter-add over a
+large vocabulary is sort-shaped, and XLA's TPU lowering of ``.at[].add``
+already emits the sort-based segmented reduction that suits the hardware
+(SURVEY.md §7 step 3 says "Pallas scatter-add if profiling demands" — it
+doesn't: the wordcount path is host-ingest-bound, see ``engines/sweep``
+timings).  A hand kernel would have to one-hot compare each id block
+against the vocab (O(N·V) VPU work) — strictly worse than XLA's O(N log N).
+The Pallas budget went to the ops where explicit locality wins:
+``ops/flash_attention.py`` and ``ops/pallas_keyword.py``.
 """
 
 from __future__ import annotations

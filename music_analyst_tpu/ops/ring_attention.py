@@ -48,10 +48,17 @@ def _block_attn_update(q, k_blk, v_blk, q_pos, kv_pos, causal, m, l, o):
     return new_m, new_l, new_o
 
 
-def ring_attention_local(q, k, v, axis_name: str, causal: bool = False):
+def ring_attention_local(q, k, v, axis_name: str, causal: bool = False,
+                         use_flash: bool = False):
     """Per-device body; call under ``shard_map`` with sequence sharded.
 
     Shapes per device: ``q,k,v [B, S/n, H, D]``.  Returns ``[B, S/n, H, D]``.
+
+    ``use_flash=True`` computes each hop's local attention with the Pallas
+    blocked kernel (``ops/flash_attention.py``) via its offset + residual
+    hooks, then merges the per-hop ``(o, m, l)`` partials with the same
+    online-softmax algebra — VMEM-blocked compute inside each hop, ICI
+    ``ppermute`` between hops.
     """
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -71,10 +78,27 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = False):
         # neighbor), this device holds the block originally owned by
         # idx - step.
         owner = (idx - step) % n
-        kv_pos = owner * S_loc + jnp.arange(S_loc)
-        m, l, o = _block_attn_update(
-            q, k_blk, v_blk, q_pos, kv_pos, causal, m, l, o
-        )
+        if use_flash:
+            from music_analyst_tpu.ops.flash_attention import flash_attention
+
+            o_i, m_i, l_i = flash_attention(
+                q, k_blk, v_blk, causal=causal,
+                q_offset=idx * S_loc, kv_offset=owner * S_loc,
+                return_residuals=True,
+            )
+            o_i = jnp.transpose(o_i, (0, 2, 1, 3))     # [B,H,Q,D]
+            m_new = jnp.maximum(m, m_i)
+            c_prev = jnp.exp(m - m_new)
+            c_hop = jnp.exp(jnp.where(m_i > _NEG_INF / 2, m_i - m_new,
+                                      -jnp.inf))
+            l = l * c_prev + l_i * c_hop
+            o = o * c_prev[..., None] + o_i * c_hop[..., None]
+            m = m_new
+        else:
+            kv_pos = owner * S_loc + jnp.arange(S_loc)
+            m, l, o = _block_attn_update(
+                q, k_blk, v_blk, q_pos, kv_pos, causal, m, l, o
+            )
         perm = [(i, (i + 1) % n) for i in range(n)]
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
@@ -92,14 +116,19 @@ def ring_attention(
     mesh: Mesh,
     axis: str = "sp",
     causal: bool = False,
+    use_flash: bool = False,
 ) -> jax.Array:
     """Sequence-parallel attention: ``[B, S, H, D]`` sharded on S over ``axis``."""
     fn = jax.jit(
         jax.shard_map(
-            partial(ring_attention_local, axis_name=axis, causal=causal),
+            partial(ring_attention_local, axis_name=axis, causal=causal,
+                    use_flash=use_flash),
             mesh=mesh,
             in_specs=(P(None, axis), P(None, axis), P(None, axis)),
             out_specs=P(None, axis),
+            # pallas_call outputs carry no varying-mesh-axis annotation;
+            # skip the vma check on the flash path.
+            check_vma=not use_flash,
         )
     )
     return fn(q, k, v)

@@ -1,0 +1,35 @@
+// Race-detection selftest for the multithreaded ingest.
+//
+// The reference has no sanitizers at all (SURVEY.md §5 "Race detection:
+// none"; its Makefile is warnings-only).  This binary drives the full
+// threaded pipeline — parallel quote-parity boundary scan + per-thread
+// record parse/tokenize/intern + merge — so it can run under
+// -fsanitize=thread (`make -C native selftest_tsan`), where any data race
+// in the chunk handoff or interner merge becomes a hard failure.
+//
+// Usage: selftest <csv_path> [threads]
+
+#include "ingest.cpp"
+
+#include <cstdio>
+#include <cstdlib>
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <csv_path> [threads]\n", argv[0]);
+    return 2;
+  }
+  int threads = argc > 2 ? std::atoi(argv[2]) : 8;
+  void* h = man_ingest(argv[1], -1, threads);
+  const char* err = man_error(h);
+  if (err && *err) {
+    std::fprintf(stderr, "ingest error: %s\n", err);
+    man_free(h);
+    return 1;
+  }
+  std::printf("songs=%lld tokens=%lld words=%d artists=%d threads=%d\n",
+              man_song_count(h), man_token_count(h), man_word_vocab_size(h),
+              man_artist_vocab_size(h), threads);
+  man_free(h);
+  return 0;
+}

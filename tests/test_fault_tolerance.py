@@ -166,3 +166,55 @@ def test_ollama_client_error_not_retried(monkeypatch):
     with pytest.raises(requests.HTTPError):
         clf.classify_batch(["some lyrics"])
     assert len(calls) == 1
+
+
+def test_resume_torn_inside_quoted_field(tmp_path):
+    """A newline inside an open quoted field is row content, not a row end;
+    truncation must cut back to the last real row boundary."""
+    part_dir = tmp_path / "p"
+    run_sentiment(FIXTURE, mock=True, limit=3, output_dir=str(part_dir),
+                  quiet=True)
+    details = part_dir / "sentiment_details.csv"
+    before = _read_details(details)
+    # torn write: quoted field opened, interior newline, then the kill
+    with open(details, "ab") as fh:
+        fh.write(b'"Torn\nArtist,Torn Song,Pos')
+
+    resumed = run_sentiment(FIXTURE, mock=True, output_dir=str(part_dir),
+                            quiet=True, resume=True)
+    rows = _read_details(details)
+    assert rows[:3] == before
+    assert len(rows) == 3 + len(resumed.rows)
+    assert all("\n" not in r["label"] for r in rows)
+
+
+def test_sync_backend_latencies_not_shifted_across_batches(tmp_path):
+    """Measured per-song latencies must stay with their own batch even
+    though the engine submits batch i+1 before collecting batch i."""
+
+    class MeasuringBackend:
+        name = "meter"
+        reports_latency = True
+
+        def __init__(self):
+            self.batch_no = 0
+            self.last_latencies = []
+
+        def classify_batch(self, texts):
+            self.batch_no += 1
+            # batch 1 -> 1.0s each, batch 2 -> 2.0s each, ...
+            self.last_latencies = [float(self.batch_no)] * len(texts)
+            return ["Neutral"] * len(texts)
+
+        def submit(self, texts):
+            return self.classify_batch(texts)
+
+        def collect(self, handle):
+            return handle
+
+    run_sentiment(FIXTURE, backend=MeasuringBackend(), batch_size=2,
+                  output_dir=str(tmp_path), quiet=True)
+    rows = _read_details(tmp_path / "sentiment_details.csv")
+    # rows 0-1 from batch 1, rows 2-3 from batch 2, ...
+    for i, row in enumerate(rows):
+        assert float(row["latency_seconds"]) == float(i // 2 + 1), (i, row)

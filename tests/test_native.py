@@ -134,3 +134,33 @@ def test_lone_cr_dataset(tmp_path):
     n = native.ingest_native(str(path))
     p = ingest_python(data)
     assert_parity(n, p)
+
+
+def test_tsan_selftest(tmp_path):
+    """Full threaded pipeline under ThreadSanitizer: any data race in the
+    boundary-scan handoff or interner merge fails hard.  (The reference has
+    no race detection at all — SURVEY.md §5.)"""
+    import os
+    import shutil
+    import subprocess
+
+    if shutil.which("g++") is None:
+        pytest.skip("g++ unavailable")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    build = subprocess.run(
+        ["make", "-C", os.path.join(repo, "native"), "selftest_tsan"],
+        capture_output=True, text=True,
+    )
+    if build.returncode != 0:
+        pytest.skip(f"tsan build unavailable: {build.stderr[-200:]}")
+    from music_analyst_tpu.data.synthetic import generate_dataset
+
+    path = tmp_path / "songs.csv"
+    generate_dataset(str(path), num_songs=2000, seed=7)
+    run = subprocess.run(
+        [os.path.join(repo, "native", "selftest_tsan"), str(path), "8"],
+        capture_output=True, text=True,
+    )
+    assert run.returncode == 0, run.stderr
+    assert "ThreadSanitizer" not in run.stderr, run.stderr
+    assert "songs=2000" in run.stdout

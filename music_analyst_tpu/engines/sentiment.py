@@ -119,13 +119,19 @@ def _read_completed_details(details_path: str) -> Tuple[int, Dict[str, int]]:
     """
     with open(details_path, "rb+") as raw:
         data = raw.read()
-        in_quote = False
+        # Even-indexed split('"') segments sit at even quote parity ('""'
+        # escapes contribute two quotes, preserving parity), so the last
+        # newline inside one is the last real row boundary.  split+rfind
+        # keeps the scan at C speed — this runs on every --resume of
+        # multi-GB detail files.
         keep = 0
-        for i, byte in enumerate(data):
-            if byte == 0x22:  # '"' — "" escapes toggle twice, net even
-                in_quote = not in_quote
-            elif byte == 0x0A and not in_quote:
-                keep = i + 1
+        offset = 0
+        for i, seg in enumerate(data.split(b'"')):
+            if i % 2 == 0:
+                nl = seg.rfind(b"\n")
+                if nl >= 0:
+                    keep = offset + nl + 1
+            offset += len(seg) + 1  # + the '"' separator
         if keep != len(data):
             raw.truncate(keep)
     done = 0

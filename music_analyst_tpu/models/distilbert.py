@@ -67,7 +67,8 @@ class TransformerBlock(nn.Module):
         attn_out = MultiHeadAttention(
             n_heads=cfg.n_heads, dtype=dtype, attn_impl=cfg.attn_impl,
             name="attention",
-        )(x, mask=mask, lengths=lengths)
+        )(x, mask=None if cfg.attn_impl == "flash" else mask,
+          lengths=lengths)
         x = nn.LayerNorm(name="sa_layer_norm", dtype=dtype)(x + attn_out)
         mlp_out = GeluMLP(cfg.hidden_dim, dtype=dtype, name="ffn")(x)
         return nn.LayerNorm(name="output_layer_norm", dtype=dtype)(x + mlp_out)

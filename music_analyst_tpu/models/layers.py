@@ -196,13 +196,15 @@ class MultiHeadAttention(nn.Module):
             from music_analyst_tpu.ops.flash_attention import flash_attention
 
             # The flash kernel expresses masking ONLY via flash_causal +
-            # lengths; an arbitrary `mask` array can't reach it.  A mask
-            # with neither of those set would be silently dropped — refuse.
-            if mask is not None and lengths is None and not self.flash_causal:
+            # lengths; an arbitrary `mask` array can't reach it and would
+            # be silently dropped — refuse outright.  Callers on the flash
+            # path pass mask=None and encode semantics in flash_causal /
+            # lengths (see LlamaBlock / DistilBert TransformerBlock).
+            if mask is not None:
                 raise ValueError(
-                    "attn_impl='flash' ignores the mask argument; pass "
-                    "lengths= (padding) and/or set flash_causal instead, "
-                    "or use attn_impl='dense' for arbitrary masks"
+                    "attn_impl='flash' cannot apply a mask array; pass "
+                    "mask=None with lengths= (padding) and/or flash_causal "
+                    "set, or use attn_impl='dense' for arbitrary masks"
                 )
             out = flash_attention(
                 q, k, v, lengths=lengths, causal=self.flash_causal

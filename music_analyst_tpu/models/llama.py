@@ -120,8 +120,14 @@ class LlamaBlock(nn.Module):
                 h, mask=mask, positions=positions, cache=cache
             )
         else:
-            attn_out = attn(h, mask=mask, positions=positions,
-                            lengths=lengths)
+            # Flash path: masking is fully described by flash_causal=True +
+            # lengths, so the (causal & padding) mask array stays out.
+            attn_out = attn(
+                h,
+                mask=None if cfg.attn_impl == "flash" else mask,
+                positions=positions,
+                lengths=lengths,
+            )
             new_cache = None
         x = x + attn_out
         h = RMSNorm(name="ffn_norm")(x)
@@ -212,10 +218,18 @@ def load_hf_torch_checkpoint(params, path: str):
         shards = [path]
     sd = {}
     for shard in shards:
-        loaded = torch.load(shard, map_location="cpu", weights_only=True)
-        if not isinstance(loaded, dict):
-            continue  # not a state_dict (e.g. a stray scalar/args pickle)
-        sd.update(loaded)
+        try:
+            loaded = torch.load(shard, map_location="cpu", weights_only=True)
+        except Exception:
+            if len(shards) == 1:
+                raise
+            continue  # auxiliary pickle (args/optimizer) in a weights dir
+        if isinstance(loaded, dict):
+            sd.update(loaded)
+    if not sd:
+        raise ValueError(
+            f"no tensors found in {path} — not a torch state_dict?"
+        )
     # Tolerate both bare-model ("model.layers...") and prefixed keys.
     sd = { (k[len("model."):] if k.startswith("model.") else k): v
            for k, v in sd.items() }
@@ -280,7 +294,14 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         max_prompt_len: int = 1024,
         mesh=None,
         seed: int = 0,
+        decode_mode: str = "score",
     ) -> None:
+        if decode_mode not in ("score", "generate"):
+            raise ValueError(
+                f"decode_mode must be 'score' or 'generate', got "
+                f"{decode_mode!r}"
+            )
+        self.decode_mode = decode_mode
         self.config = config or LlamaConfig.tiny()
         self.max_prompt_len = max_prompt_len
         self.tokenizer = resolve_llama_tokenizer(self.config.vocab_size)
@@ -415,6 +436,71 @@ class LlamaZeroShotClassifier(ClassifierBackend):
 
         self._decode_step = _decode_step
 
+        @partial(jax.jit, static_argnames=("max_new_tokens",))
+        def _generate_scan(params, prompt_ids, prompt_lens, max_new_tokens):
+            """Batched greedy decode as ONE compiled program.
+
+            The reference's generation is a remote server call per song
+            (``scripts/sentiment_classifier.py:94``); a naive on-device port
+            would still pay one host→device round-trip per token.  Here
+            prefill + every decode step run inside a single jit: the token
+            loop is a ``lax.scan`` over the KV cache (static trip count,
+            EOS handled by masking, not early exit — XLA-shaped control
+            flow, SURVEY.md §2.4 design notes).
+            """
+            B, S = prompt_ids.shape
+            positions = jnp.arange(S)[None, :].repeat(B, 0)
+            total = S + max_new_tokens
+            mask = causal_mask(S, total, 0) & jnp.pad(
+                padding_mask(prompt_lens, S),
+                ((0, 0), (0, 0), (0, 0), (0, max_new_tokens)),
+            )
+            caches = init_caches(self.config, B, total)
+            logits, caches = self.model.apply(
+                {"params": params}, prompt_ids, positions, mask, caches
+            )
+            caches = [
+                KVCache(c.keys, c.values, jnp.asarray(S, jnp.int32))
+                for c in caches
+            ]
+            first = jnp.argmax(
+                jnp.take_along_axis(
+                    logits, (prompt_lens - 1)[:, None, None], axis=1
+                )[:, 0],
+                axis=-1,
+            )  # [B]
+            eos = jnp.asarray(self.tokenizer.eos_id, jnp.int32)
+
+            def step(carry, t):
+                # Ragged prompts: row b's decode token t sits at *slot*
+                # S + t (uniform, so one dynamic_update_slice serves the
+                # whole batch) while its *position* is prompt_lens[b] + t
+                # (per-row, for RoPE and the mask) — the same slot/position
+                # split _score_labels uses.
+                token, done, caches = carry
+                pos = prompt_lens + t                              # [B]
+                kv_pos = jnp.arange(total)[None, None, None, :]
+                prompt_part = kv_pos < prompt_lens[:, None, None, None]
+                decode_part = (kv_pos >= S) & (kv_pos - S <= t)
+                step_mask = prompt_part | decode_part
+                lg, caches = self.model.apply(
+                    {"params": params}, token[:, None], pos[:, None],
+                    step_mask, caches,
+                )
+                nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+                done = done | (token == eos)
+                nxt = jnp.where(done, eos, nxt)
+                return (nxt, done, caches), token
+
+            (_, _, caches), tokens = jax.lax.scan(
+                step,
+                (first.astype(jnp.int32), first == eos, caches),
+                jnp.arange(max_new_tokens),
+            )
+            return tokens.T  # [B, max_new_tokens]
+
+        self._generate_scan = _generate_scan
+
     @classmethod
     def from_pretrained_or_random(cls, model: str, **kwargs):
         preset = PRESETS.get(model)
@@ -442,6 +528,8 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         return self.tokenizer.encode_batch(prompts, self.max_prompt_len)
 
     def classify_batch(self, texts: Sequence[str]) -> List[str]:
+        if self.decode_mode == "generate":
+            return self.classify_batch_by_generation(texts)
         prompt_ids, prompt_lens = self._encode_prompts(texts)
         scores = np.asarray(
             self._score_labels(
@@ -494,7 +582,52 @@ class LlamaZeroShotClassifier(ClassifierBackend):
             position = position + 1
         return self.tokenizer.decode(out_tokens)
 
+    def generate_batch(
+        self, prompts: Sequence[str], max_new_tokens: int = 16
+    ) -> List[str]:
+        """Greedy generation for a whole batch in ONE compiled program.
+
+        Prefill and all ``max_new_tokens`` decode steps run inside a single
+        jit (``lax.scan`` over the KV cache) — no per-token host↔device
+        round-trips, unlike :meth:`generate`'s explicit step loop (kept for
+        API parity and as the differential oracle).
+        """
+        ids, lens = self.tokenizer.encode_batch(prompts, self.max_prompt_len)
+        tokens = np.asarray(
+            self._generate_scan(
+                self.params, jnp.asarray(ids), jnp.asarray(lens),
+                max_new_tokens,
+            )
+        )
+        eos = self.tokenizer.eos_id
+        outs = []
+        for row in tokens:
+            ids_out = []
+            for t in row:
+                if t == eos:
+                    break
+                ids_out.append(int(t))
+            outs.append(self.tokenizer.decode(ids_out))
+        return outs
+
     def classify_by_generation(self, text: str) -> str:
         """Reference-semantics path: generate text, normalise first token."""
         prompt = PROMPT_TEMPLATE.format(lyrics=text.strip()[:LYRICS_TRUNCATION])
         return normalise_label(self.generate(prompt))
+
+    def classify_batch_by_generation(
+        self, texts: Sequence[str]
+    ) -> List[str]:
+        """Reference generation semantics at batch speed: free-text decode
+        (one scan-jitted program for the whole batch) then the shared label
+        normalizer (``scripts/sentiment_classifier.py:102-108``, empty-
+        output crash fixed)."""
+        prompts = [
+            PROMPT_TEMPLATE.format(lyrics=t.strip()[:LYRICS_TRUNCATION])
+            for t in texts
+        ]
+        generations = self.generate_batch(prompts, max_new_tokens=8)
+        return [
+            "Neutral" if not text.strip() else normalise_label(gen)
+            for text, gen in zip(texts, generations)
+        ]

@@ -190,3 +190,43 @@ class TestTensorParallel:
         assert specs["tok_embeddings"]["embedding"] == P("tp", None)
         assert specs["lm_head"]["kernel"] == P(None, "tp")
         assert specs["norm"]["scale"] == P()
+
+
+def test_generate_scan_matches_step_loop():
+    """Single-jit scan generation ≡ the explicit per-token step loop."""
+    from music_analyst_tpu.models.llama import (
+        LlamaConfig,
+        LlamaZeroShotClassifier,
+    )
+
+    cfg = LlamaConfig(
+        vocab_size=300, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        hidden_dim=64, rope_theta=1e4, max_seq_len=128, dtype="float32",
+    )
+    clf = LlamaZeroShotClassifier(config=cfg, max_prompt_len=32, seed=3)
+    prompts = ["hello world", "la la la la la la", "x"]
+    batched = clf.generate_batch(prompts, max_new_tokens=8)
+    singles = [clf.generate(p, max_new_tokens=8) for p in prompts]
+    assert batched == singles
+
+
+def test_generation_decode_mode():
+    """decode_mode='generate' classifies via batched free-text decode +
+    the shared normalizer, honoring the empty-lyric rule."""
+    from music_analyst_tpu.models.llama import (
+        LlamaConfig,
+        LlamaZeroShotClassifier,
+    )
+
+    cfg = LlamaConfig(
+        vocab_size=300, dim=32, n_layers=1, n_heads=4, n_kv_heads=2,
+        hidden_dim=64, rope_theta=1e4, max_seq_len=128, dtype="float32",
+    )
+    clf = LlamaZeroShotClassifier(
+        config=cfg, max_prompt_len=32, decode_mode="generate"
+    )
+    labels = clf.classify_batch(["some lyrics", ""])
+    assert labels[1] == "Neutral"
+    assert all(l in ("Positive", "Neutral", "Negative") for l in labels)
+    singles = [clf.classify_by_generation("some lyrics")]
+    assert labels[0] == singles[0]  # batch ≡ single-song reference path

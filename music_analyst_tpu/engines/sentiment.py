@@ -119,19 +119,25 @@ def _read_completed_details(details_path: str) -> Tuple[int, Dict[str, int]]:
     """
     with open(details_path, "rb+") as raw:
         data = raw.read()
-        # Even-indexed split('"') segments sit at even quote parity ('""'
-        # escapes contribute two quotes, preserving parity), so the last
-        # newline inside one is the last real row boundary.  split+rfind
-        # keeps the scan at C speed — this runs on every --resume of
-        # multi-GB detail files.
+        # Walk newlines backward from EOF until one sits at even quote
+        # parity ('""' escapes contribute two quotes, preserving parity):
+        # that's the last real row boundary.  parity(prefix) is derived
+        # from the total quote count minus an incrementally-grown suffix
+        # count, so only the (short) torn tail is rescanned — no second
+        # copy of a multi-GB details file is ever materialized.
+        total_quotes = data.count(b'"')
         keep = 0
-        offset = 0
-        for i, seg in enumerate(data.split(b'"')):
-            if i % 2 == 0:
-                nl = seg.rfind(b"\n")
-                if nl >= 0:
-                    keep = offset + nl + 1
-            offset += len(seg) + 1  # + the '"' separator
+        suffix_quotes = 0
+        pos = len(data)
+        while True:
+            nl = data.rfind(b"\n", 0, pos)
+            if nl < 0:
+                break
+            suffix_quotes += data.count(b'"', nl + 1, pos)
+            if (total_quotes - suffix_quotes) % 2 == 0:
+                keep = nl + 1
+                break
+            pos = nl
         if keep != len(data):
             raw.truncate(keep)
     done = 0

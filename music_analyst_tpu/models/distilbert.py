@@ -88,6 +88,9 @@ class DistilBertEncoder(nn.Module):
                        name="position_embeddings")(positions)
         x = nn.LayerNorm(name="embed_layer_norm", dtype=dtype)(tok + pos)
         mask = padding_mask(lengths, token_ids.shape[1])
+        # CONTRACT: with cfg.attn_impl == "flash", attention masking is
+        # derived from `lengths` alone (key padding); the mask array is
+        # only consumed by the dense impl.
         for i in range(cfg.n_layers):
             x = TransformerBlock(cfg, name=f"layer_{i}")(x, mask, lengths)
         return x

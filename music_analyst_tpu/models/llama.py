@@ -156,6 +156,12 @@ class LlamaModel(nn.Module):
         caches: Optional[List[KVCache]] = None,
         lengths: Optional[jax.Array] = None,       # [B] — flash path masks
     ):
+        # CONTRACT: with cfg.attn_impl == "flash" (and no caches), the
+        # `mask` argument is NOT applied — attention is causal + key-
+        # padding-by-`lengths`, full stop.  Callers needing any other mask
+        # (sliding window, prefix-LM, cross-attention) must use the dense
+        # impl; MultiHeadAttention raises if a mask array reaches the
+        # flash branch directly.
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=dtype,
@@ -220,10 +226,11 @@ def load_hf_torch_checkpoint(params, path: str):
     for shard in shards:
         try:
             loaded = torch.load(shard, map_location="cpu", weights_only=True)
-        except Exception:
-            if len(shards) == 1:
-                raise
-            continue  # auxiliary pickle (args/optimizer) in a weights dir
+        except Exception as exc:
+            # Never skip silently: a truncated weight shard skipped here
+            # would surface as a confusing missing-key error (or worse,
+            # a silent tied-embedding fallback) far from the cause.
+            raise RuntimeError(f"failed to load shard {shard}") from exc
         if isinstance(loaded, dict):
             sd.update(loaded)
     if not sd:
@@ -626,7 +633,9 @@ class LlamaZeroShotClassifier(ClassifierBackend):
             PROMPT_TEMPLATE.format(lyrics=t.strip()[:LYRICS_TRUNCATION])
             for t in texts
         ]
-        generations = self.generate_batch(prompts, max_new_tokens=8)
+        # Same token budget as generate()'s default so the batch path and
+        # the single-song reference path yield identical labels.
+        generations = self.generate_batch(prompts, max_new_tokens=16)
         return [
             "Neutral" if not text.strip() else normalise_label(gen)
             for text, gen in zip(texts, generations)

@@ -114,15 +114,30 @@ def score_texts(
 ) -> np.ndarray:
     """Exact batched scores for arbitrary-length lyrics.
 
-    Songs fitting in ``length`` bytes go through the dense kernel in one
-    batch; longer songs are re-scored over overlapping windows (overlap
-    ``MAX_KEYWORD_LEN - 1`` so no match can straddle a boundary), OR-ing
-    per-window containment via per-keyword score decomposition.
+    The batch is padded only to the power-of-two bucket covering its
+    longest row (floor 512, cap ``length``): host→device transfer is the
+    bottleneck for this kernel, and fixed-``length`` padding would move
+    ~4x the bytes for typical lyrics.  Power-of-two buckets keep the jit
+    cache to at most four shapes.  Songs above the cap are re-scored over
+    overlapping windows (overlap ``MAX_KEYWORD_LEN - 1`` so no match can
+    straddle a boundary) — exact for any length.
     """
-    batch, overflow = encode_batch(texts, length)
+    encoded = [t.strip().encode("utf-8", errors="replace") for t in texts]
+    max_bytes = max((len(d) for d in encoded), default=1)
+    bucket = 512
+    while bucket < min(max_bytes, length):
+        bucket <<= 1
+    bucket = min(bucket, length)
+    batch = np.zeros((len(encoded), bucket), dtype=np.uint8)
+    overflow: List[int] = []
+    for i, data in enumerate(encoded):
+        if len(data) > bucket:
+            overflow.append(i)
+            data = data[:bucket]
+        batch[i, : len(data)] = np.frombuffer(data, dtype=np.uint8)
     scores = np.array(keyword_scores(batch))
     for i in overflow:
-        scores[i] = _score_long_text(texts[i].strip(), length)
+        scores[i] = _score_long_text(texts[i].strip(), bucket)
     return scores
 
 

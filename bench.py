@@ -24,6 +24,11 @@ PER_CHIP_TARGET = 16_667 / 8  # songs/sec per chip for the <60s/1M goal
 def main() -> int:
     import jax
 
+    from music_analyst_tpu.utils.cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    enable_persistent_compilation_cache()
     n_chips = len(jax.devices())
 
     from music_analyst_tpu.data.synthetic import generate_dataset

@@ -65,19 +65,50 @@ def make_optimizer(
     return optax.adamw(learning_rate, weight_decay=weight_decay)
 
 
+def zero1_shard_opt_state(opt_state, mesh: Mesh):
+    """Shard optimizer-state arrays over the ``dp`` axis (ZeRO stage 1).
+
+    Data-parallel replicas don't need replicated Adam moments — each can
+    own a slice of them (cross-replica sharding of the weight update,
+    arXiv:2004.13336; PAPERS.md).  Each moment leaf gets ``dp`` assigned to
+    its first divisible, still-unsharded dimension, composing with the
+    tp/ep specs it inherited from the params.  GSPMD derives the
+    reduce-scatter/all-gather pair around the update from the sharding
+    mismatch — no hand-written collectives.
+    """
+    dp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("dp", 1)
+    if dp <= 1:
+        return opt_state
+
+    def place(leaf):
+        if not hasattr(leaf, "ndim") or leaf.ndim == 0:
+            return leaf
+        spec = list(getattr(getattr(leaf, "sharding", None), "spec", ()))
+        spec += [None] * (leaf.ndim - len(spec))
+        for i in range(leaf.ndim):
+            if spec[i] is None and leaf.shape[i] % dp == 0:
+                spec[i] = "dp"
+                return jax.device_put(leaf, NamedSharding(mesh, P(*spec)))
+        return leaf  # no divisible free axis — stays as-is
+
+    return jax.tree_util.tree_map(place, opt_state)
+
+
 def init_train_state(
     model,
     optimizer: optax.GradientTransformation,
     sample_batch: Tuple[jax.Array, jax.Array],
     seed: int = 0,
     mesh: Optional[Mesh] = None,
+    zero1: bool = False,
 ) -> TrainState:
     """Initialize params + optimizer state, sharded over ``mesh`` if given.
 
     Parameters and every optimizer-state leaf that mirrors a parameter
     (Adam moments) share the same partition spec, so optimizer memory
-    scales down with ``tp``/``ep`` exactly like the weights (ZeRO-style
-    for the model axes).
+    scales down with ``tp``/``ep`` exactly like the weights.  With
+    ``zero1=True`` the moments additionally shard over ``dp``
+    (:func:`zero1_shard_opt_state`).
     """
     token_ids, lengths = sample_batch
     S = token_ids.shape[1] - 1
@@ -105,17 +136,23 @@ def init_train_state(
         # Re-initializing from the sharded params makes every Adam moment
         # (zeros_like of a sharded leaf) inherit that leaf's sharding.
         opt_state = optimizer.init(params)
+        if zero1:
+            opt_state = zero1_shard_opt_state(opt_state, mesh)
     return TrainState(
         params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32)
     )
 
 
-def make_train_step(model, optimizer, mesh: Optional[Mesh] = None):
+def make_train_step(model, optimizer, mesh: Optional[Mesh] = None,
+                    state_like: Optional[TrainState] = None):
     """Build the jitted SPMD train step.
 
     With a mesh, the token batch shards ``P('dp', 'sp')`` (batch over data
-    ranks, sequence over sequence ranks) and outputs keep the state's
-    shardings; without one it is a plain single-device jit.
+    ranks, sequence over sequence ranks) and the output state is pinned to
+    the *input* state's shardings (derived from the first call, or from
+    ``state_like`` if given) — required for ZeRO-1, where the moments'
+    dp-sharding must survive the update instead of being re-replicated by
+    the compiler, and harmless otherwise.
     """
 
     def step_fn(state: TrainState, token_ids, lengths):
@@ -145,4 +182,30 @@ def make_train_step(model, optimizer, mesh: Optional[Mesh] = None):
         lengths = jax.lax.with_sharding_constraint(lengths, lengths_sharding)
         return step_fn(state, token_ids, lengths)
 
-    return jax.jit(sharded_step)
+    def _shardings_of(state):
+        return jax.tree_util.tree_map(
+            lambda x: x.sharding
+            if isinstance(getattr(x, "sharding", None), NamedSharding)
+            else None,
+            state,
+        )
+
+    if state_like is not None:
+        return jax.jit(
+            sharded_step, out_shardings=(_shardings_of(state_like), None)
+        )
+
+    # Derive output shardings from the first concrete state: a single knob
+    # (init_train_state(zero1=True)) then suffices — forgetting a separate
+    # state_like can't silently re-replicate the moments.
+    jitted = None
+
+    def first_call_pins_shardings(state, token_ids, lengths):
+        nonlocal jitted
+        if jitted is None:
+            jitted = jax.jit(
+                sharded_step, out_shardings=(_shardings_of(state), None)
+            )
+        return jitted(state, token_ids, lengths)
+
+    return first_call_pins_shardings

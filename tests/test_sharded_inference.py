@@ -1,0 +1,87 @@
+"""Classifier backends on a mesh ≡ unsharded (the 8-device CPU emulation
+of the reference's 'mpirun -np 8 on one box', SURVEY.md §4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from music_analyst_tpu.parallel.mesh import MeshSpec, build_mesh
+
+TEXTS = [
+    "love and sunshine all day",
+    "tears and pain in the lonely night",
+    "",
+    "la la la " * 40,
+    "cry me a river of joy",
+]
+
+
+@pytest.fixture(scope="module")
+def dp_mesh():
+    return build_mesh(MeshSpec((("dp", 8),)))
+
+
+@pytest.fixture(scope="module")
+def dp_tp_mesh():
+    return build_mesh(MeshSpec((("dp", 2), ("tp", 4))))
+
+
+def test_distilbert_dp_sharded_matches_unsharded(dp_mesh):
+    from music_analyst_tpu.models.distilbert import (
+        DistilBertClassifier,
+        DistilBertConfig,
+    )
+
+    cfg = DistilBertConfig.tiny()
+    plain = DistilBertClassifier(config=cfg, max_len=64, seed=5)
+    sharded = DistilBertClassifier(config=cfg, max_len=64, seed=5,
+                                   mesh=dp_mesh)
+    assert plain.classify_batch(TEXTS) == sharded.classify_batch(TEXTS)
+
+
+def test_distilbert_dp_tp_sharded_matches_unsharded(dp_tp_mesh):
+    from music_analyst_tpu.models.distilbert import (
+        DistilBertClassifier,
+        DistilBertConfig,
+    )
+
+    cfg = DistilBertConfig.tiny()
+    plain = DistilBertClassifier(config=cfg, max_len=64, seed=6)
+    sharded = DistilBertClassifier(config=cfg, max_len=64, seed=6,
+                                   mesh=dp_tp_mesh)
+    assert plain.classify_batch(TEXTS) == sharded.classify_batch(TEXTS)
+
+
+def test_llama_tp_sharded_matches_unsharded(dp_tp_mesh):
+    from music_analyst_tpu.models.llama import (
+        LlamaConfig,
+        LlamaZeroShotClassifier,
+    )
+
+    cfg = LlamaConfig(
+        vocab_size=300, dim=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        hidden_dim=64, rope_theta=1e4, max_seq_len=128, dtype="float32",
+    )
+    plain = LlamaZeroShotClassifier(config=cfg, max_prompt_len=64, seed=7)
+    sharded = LlamaZeroShotClassifier(config=cfg, max_prompt_len=64, seed=7,
+                                      mesh=dp_tp_mesh)
+    assert plain.classify_batch(TEXTS) == sharded.classify_batch(TEXTS)
+
+
+def test_sentiment_engine_with_mesh_backend(dp_mesh, tmp_path):
+    """run_sentiment over a mesh-backed classifier produces the standard
+    artifacts with all songs accounted for."""
+    from music_analyst_tpu.engines.sentiment import run_sentiment
+    from music_analyst_tpu.models.distilbert import (
+        DistilBertClassifier,
+        DistilBertConfig,
+    )
+
+    backend = DistilBertClassifier(
+        config=DistilBertConfig.tiny(), max_len=64, mesh=dp_mesh
+    )
+    result = run_sentiment(
+        "tests/fixtures/mini_songs.csv", backend=backend, batch_size=3,
+        output_dir=str(tmp_path), quiet=True,
+    )
+    assert sum(result.counts.values()) == len(result.rows) == 8

@@ -117,17 +117,6 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_sweep(sub)
     args = parser.parse_args(argv)
 
-    if args.command in ("analyze", "sentiment", "sweep"):
-        # Device-touching subcommands reuse programs compiled by earlier
-        # runs (first compile is the dominant cold-start cost on TPU);
-        # split / wordcount-per-song are pure host paths and skip the
-        # jax import entirely.
-        from music_analyst_tpu.utils.cache import (
-            enable_persistent_compilation_cache,
-        )
-
-        enable_persistent_compilation_cache()
-
     if args.command == "sweep":
         from music_analyst_tpu.engines.sweep import run_sweep
 

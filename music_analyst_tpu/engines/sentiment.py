@@ -171,6 +171,15 @@ def run_sentiment(
     (SURVEY.md §5 "Checkpoint/resume: none").
     """
     os.makedirs(output_dir, exist_ok=True)
+    if backend is None and not (mock or model == "mock"):
+        # Device backends reuse programs compiled by earlier processes
+        # (the engine enables this itself — same pattern as run_analysis —
+        # so library callers get it too, not just the CLI).
+        from music_analyst_tpu.utils.cache import (
+            enable_persistent_compilation_cache,
+        )
+
+        enable_persistent_compilation_cache()
     clf = backend if backend is not None else get_backend(model, mock=mock)
 
     totals_path = os.path.join(output_dir, "sentiment_totals.json")

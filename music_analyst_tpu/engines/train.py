@@ -76,7 +76,7 @@ def zero1_shard_opt_state(opt_state, mesh: Mesh):
     reduce-scatter/all-gather pair around the update from the sharding
     mismatch — no hand-written collectives.
     """
-    dp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("dp", 1)
+    dp = mesh.shape.get("dp", 1)
     if dp <= 1:
         return opt_state
 
@@ -143,16 +143,15 @@ def init_train_state(
     )
 
 
-def make_train_step(model, optimizer, mesh: Optional[Mesh] = None,
-                    state_like: Optional[TrainState] = None):
+def make_train_step(model, optimizer, mesh: Optional[Mesh] = None):
     """Build the jitted SPMD train step.
 
     With a mesh, the token batch shards ``P('dp', 'sp')`` (batch over data
     ranks, sequence over sequence ranks) and the output state is pinned to
-    the *input* state's shardings (derived from the first call, or from
-    ``state_like`` if given) — required for ZeRO-1, where the moments'
-    dp-sharding must survive the update instead of being re-replicated by
-    the compiler, and harmless otherwise.
+    the *input* state's shardings (derived per distinct input sharding
+    layout) — required for ZeRO-1, where the moments' dp-sharding must
+    survive the update instead of being re-replicated by the compiler, and
+    harmless otherwise.
     """
 
     def step_fn(state: TrainState, token_ids, lengths):
@@ -190,22 +189,24 @@ def make_train_step(model, optimizer, mesh: Optional[Mesh] = None,
             state,
         )
 
-    if state_like is not None:
-        return jax.jit(
-            sharded_step, out_shardings=(_shardings_of(state_like), None)
-        )
+    # Output shardings derive from each call's concrete input state, keyed
+    # by the state's sharding layout: init_train_state(zero1=True) is the
+    # only knob, and a step function reused across differently-sharded
+    # states (e.g. a plain smoke state, then a ZeRO-1 state) pins each
+    # layout separately instead of freezing the first one seen.
+    jitted_by_layout = {}
 
-    # Derive output shardings from the first concrete state: a single knob
-    # (init_train_state(zero1=True)) then suffices — forgetting a separate
-    # state_like can't silently re-replicate the moments.
-    jitted = None
-
-    def first_call_pins_shardings(state, token_ids, lengths):
-        nonlocal jitted
-        if jitted is None:
-            jitted = jax.jit(
-                sharded_step, out_shardings=(_shardings_of(state), None)
+    def pinned_step(state, token_ids, lengths):
+        shardings = _shardings_of(state)
+        key = tuple(
+            repr(s) for s in jax.tree_util.tree_leaves(
+                shardings, is_leaf=lambda x: x is None
             )
-        return jitted(state, token_ids, lengths)
+        )
+        if key not in jitted_by_layout:
+            jitted_by_layout[key] = jax.jit(
+                sharded_step, out_shardings=(shardings, None)
+            )
+        return jitted_by_layout[key](state, token_ids, lengths)
 
-    return first_call_pins_shardings
+    return pinned_step

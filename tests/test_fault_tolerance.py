@@ -14,7 +14,11 @@ import pytest
 
 from music_analyst_tpu.engines.sentiment import run_sentiment
 
-FIXTURE = "tests/fixtures/mini_songs.csv"
+import os
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fixtures", "mini_songs.csv"
+)
 
 
 def _read_details(path):

@@ -1,0 +1,192 @@
+"""Byte-for-byte differential tests against the reference PYTHON scripts.
+
+`test_reference_differential.py` pins parity against the compiled C
+binary; these runs execute the reference's actual Python entry points
+(`scripts/sentiment_classifier.py --mock`, `scripts/word_count_per_song.py`,
+`scripts/split_csv_columns.py`) as subprocesses on the same inputs and
+diff every artifact byte-for-byte.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REF = "/root/reference"
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fixtures", "mini_songs.csv"
+)
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(os.path.join(REF, "scripts")),
+    reason="reference scripts not mounted",
+)
+
+
+def _run_ref(script, args, cwd, expect_failure=False):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REF, "scripts", script), *args],
+        capture_output=True, text=True, cwd=cwd,
+    )
+    if expect_failure:
+        assert proc.returncode != 0
+    else:
+        assert proc.returncode == 0, proc.stderr
+    return proc
+
+
+def _clean_fixture(tmp_path):
+    """The raw fixture contains a deliberately short row that CRASHES the
+    reference scripts (DictReader yields None for missing columns and the
+    reference calls .strip() on it — scripts/sentiment_classifier.py:59,
+    the None-robustness gap SURVEY.md §2.2 P5 documents).  Differential
+    runs need an input the reference survives."""
+    import csv as _csv
+
+    out = tmp_path / "fixture_clean.csv"
+    with open(FIXTURE, newline="", encoding="utf-8") as fh:
+        rows = [r for r in _csv.reader(fh)]
+    with open(out, "w", newline="", encoding="utf-8") as fh:
+        writer = _csv.writer(fh)
+        for row in rows:
+            if len(row) >= 4:
+                writer.writerow(row)
+    return str(out)
+
+
+def _read(path):
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
+def test_mock_sentiment_byte_parity(tmp_path):
+    fixture = _clean_fixture(tmp_path)
+    ref_out = tmp_path / "ref"
+    ours_out = tmp_path / "ours"
+    _run_ref(
+        "sentiment_classifier.py",
+        [fixture, "--mock", "--output-dir", str(ref_out)],
+        cwd=str(tmp_path),
+    )
+    from music_analyst_tpu.engines.sentiment import run_sentiment
+
+    run_sentiment(fixture, mock=True, output_dir=str(ours_out), quiet=True)
+    assert _read(ref_out / "sentiment_totals.json") == _read(
+        ours_out / "sentiment_totals.json"
+    )
+    assert _read(ref_out / "sentiment_details.csv") == _read(
+        ours_out / "sentiment_details.csv"
+    )
+
+
+def test_mock_sentiment_with_limit_byte_parity(tmp_path):
+    fixture = _clean_fixture(tmp_path)
+    ref_out = tmp_path / "ref"
+    ours_out = tmp_path / "ours"
+    _run_ref(
+        "sentiment_classifier.py",
+        [fixture, "--mock", "--limit", "4", "--output-dir", str(ref_out)],
+        cwd=str(tmp_path),
+    )
+    from music_analyst_tpu.engines.sentiment import run_sentiment
+
+    run_sentiment(fixture, mock=True, limit=4, output_dir=str(ours_out),
+                  quiet=True)
+    assert _read(ref_out / "sentiment_totals.json") == _read(
+        ours_out / "sentiment_totals.json"
+    )
+    assert _read(ref_out / "sentiment_details.csv") == _read(
+        ours_out / "sentiment_details.csv"
+    )
+
+
+def test_word_count_per_song_byte_parity(tmp_path):
+    fixture = _clean_fixture(tmp_path)
+    ref_out = tmp_path / "ref"
+    ours_out = tmp_path / "ours"
+    _run_ref(
+        "word_count_per_song.py",
+        [fixture, "--output-dir", str(ref_out)],
+        cwd=str(tmp_path),
+    )
+    from music_analyst_tpu.engines.persong import run_per_song_wordcount
+
+    run_per_song_wordcount(fixture, output_dir=str(ours_out), quiet=True)
+    for name in ("word_counts_global.csv", "word_counts_by_song.csv"):
+        assert _read(ref_out / name) == _read(ours_out / name), name
+
+
+def test_split_csv_columns_byte_parity(tmp_path):
+    ref_out = tmp_path / "ref_cols"
+    ours_out = tmp_path / "our_cols"
+    _run_ref(
+        "split_csv_columns.py",
+        [FIXTURE, "--output-dir", str(ref_out)],
+        cwd=str(tmp_path),
+    )
+    from music_analyst_tpu.data.splitter import split_csv_columns
+
+    split_csv_columns(FIXTURE, output_dir=str(ours_out))
+    ref_files = sorted(os.listdir(ref_out))
+    our_files = sorted(os.listdir(ours_out))
+    assert ref_files == our_files
+    for name in ref_files:
+        assert _read(ref_out / name) == _read(ours_out / name), name
+
+
+def test_synthetic_corpus_script_parity(tmp_path):
+    """Same three scripts on a generated 300-song corpus with quoting
+    edge cases."""
+    from music_analyst_tpu.data.synthetic import generate_dataset
+
+    data = tmp_path / "songs.csv"
+    generate_dataset(str(data), num_songs=300, seed=13)
+
+    ref_out = tmp_path / "ref"
+    ours_out = tmp_path / "ours"
+    _run_ref(
+        "sentiment_classifier.py",
+        [str(data), "--mock", "--output-dir", str(ref_out)],
+        cwd=str(tmp_path),
+    )
+    from music_analyst_tpu.engines.sentiment import run_sentiment
+
+    run_sentiment(str(data), mock=True, output_dir=str(ours_out), quiet=True)
+    assert _read(ref_out / "sentiment_totals.json") == _read(
+        ours_out / "sentiment_totals.json"
+    )
+    assert _read(ref_out / "sentiment_details.csv") == _read(
+        ours_out / "sentiment_details.csv"
+    )
+
+    _run_ref(
+        "word_count_per_song.py",
+        [str(data), "--output-dir", str(ref_out / "persong")],
+        cwd=str(tmp_path),
+    )
+    from music_analyst_tpu.engines.persong import run_per_song_wordcount
+
+    run_per_song_wordcount(str(data), output_dir=str(ours_out / "persong"),
+                           quiet=True)
+    for name in ("word_counts_global.csv", "word_counts_by_song.csv"):
+        assert _read(ref_out / "persong" / name) == _read(
+            ours_out / "persong" / name
+        ), name
+
+
+def test_reference_crashes_on_short_rows_we_handle(tmp_path):
+    """Documented robustness divergence (MIGRATION.md): the reference's
+    sentiment script crashes on rows missing the text column; ours labels
+    them Neutral and keeps going."""
+    _run_ref(
+        "sentiment_classifier.py",
+        [FIXTURE, "--mock", "--output-dir", str(tmp_path / "ref")],
+        cwd=str(tmp_path),
+        expect_failure=True,
+    )
+    from music_analyst_tpu.engines.sentiment import run_sentiment
+
+    result = run_sentiment(FIXTURE, mock=True,
+                           output_dir=str(tmp_path / "ours"), quiet=True)
+    assert sum(result.counts.values()) == 8

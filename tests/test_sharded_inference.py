@@ -80,8 +80,14 @@ def test_sentiment_engine_with_mesh_backend(dp_mesh, tmp_path):
     backend = DistilBertClassifier(
         config=DistilBertConfig.tiny(), max_len=64, mesh=dp_mesh
     )
+    import os
+
+    fixture = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "fixtures",
+        "mini_songs.csv",
+    )
     result = run_sentiment(
-        "tests/fixtures/mini_songs.csv", backend=backend, batch_size=3,
+        fixture, backend=backend, batch_size=3,
         output_dir=str(tmp_path), quiet=True,
     )
     assert sum(result.counts.values()) == len(result.rows) == 8

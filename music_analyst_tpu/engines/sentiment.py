@@ -21,7 +21,7 @@ import dataclasses
 import json
 import os
 import time
-from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from music_analyst_tpu.data.csv_io import iter_songs
 from music_analyst_tpu.utils.labels import SUPPORTED_LABELS
@@ -171,10 +171,11 @@ def run_sentiment(
     (SURVEY.md §5 "Checkpoint/resume: none").
     """
     os.makedirs(output_dir, exist_ok=True)
-    if backend is None and not (mock or model == "mock"):
-        # Device backends reuse programs compiled by earlier processes
-        # (the engine enables this itself — same pattern as run_analysis —
-        # so library callers get it too, not just the CLI).
+    if backend is None:
+        # Every built-in backend compiles device programs (the mock path
+        # included — its keyword kernel is jitted), so enable the
+        # persistent cache here rather than in the CLI: library callers
+        # get it too, the pattern run_analysis established.
         from music_analyst_tpu.utils.cache import (
             enable_persistent_compilation_cache,
         )

@@ -14,7 +14,7 @@ import json
 import os
 import shutil
 import time
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 import jax
 

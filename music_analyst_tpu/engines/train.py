@@ -193,20 +193,32 @@ def make_train_step(model, optimizer, mesh: Optional[Mesh] = None):
     # by the state's sharding layout: init_train_state(zero1=True) is the
     # only knob, and a step function reused across differently-sharded
     # states (e.g. a plain smoke state, then a ZeRO-1 state) pins each
-    # layout separately instead of freezing the first one seen.
+    # layout separately instead of freezing the first one seen.  The
+    # common case — the caller feeding back the state this step returned —
+    # is an identity check, so the steady-state loop never re-derives the
+    # layout (NamedSharding is hashable, so the cold-path key is the
+    # sharding tuple itself, no string formatting).
     jitted_by_layout = {}
+    last_out = [None, None]  # [output state, jitted fn that produced it]
 
     def pinned_step(state, token_ids, lengths):
-        shardings = _shardings_of(state)
-        key = tuple(
-            repr(s) for s in jax.tree_util.tree_leaves(
-                shardings, is_leaf=lambda x: x is None
+        if state is last_out[0]:
+            jitted = last_out[1]
+        else:
+            shardings = _shardings_of(state)
+            key = tuple(
+                jax.tree_util.tree_leaves(
+                    shardings, is_leaf=lambda x: x is None
+                )
             )
-        )
-        if key not in jitted_by_layout:
-            jitted_by_layout[key] = jax.jit(
-                sharded_step, out_shardings=(shardings, None)
-            )
-        return jitted_by_layout[key](state, token_ids, lengths)
+            jitted = jitted_by_layout.get(key)
+            if jitted is None:
+                jitted = jax.jit(
+                    sharded_step, out_shardings=(shardings, None)
+                )
+                jitted_by_layout[key] = jitted
+        new_state, loss = jitted(state, token_ids, lengths)
+        last_out[0], last_out[1] = new_state, jitted
+        return new_state, loss
 
     return pinned_step

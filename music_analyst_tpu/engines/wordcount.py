@@ -21,7 +21,6 @@ import dataclasses
 import os
 from typing import List, Optional, Tuple
 
-import jax
 import numpy as np
 
 from music_analyst_tpu.data.csv_io import sort_count_entries, write_count_csv

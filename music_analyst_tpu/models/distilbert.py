@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from functools import partial
 from typing import List, Optional, Sequence
 
 import jax

@@ -21,7 +21,7 @@ from __future__ import annotations
 import dataclasses
 import os
 from functools import partial
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 from typing import List, Sequence
 
-import numpy as np
 
 from music_analyst_tpu.engines.sentiment import ClassifierBackend
 from music_analyst_tpu.ops.keyword_sentiment import score_texts

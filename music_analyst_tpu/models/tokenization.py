@@ -1,5 +1,9 @@
 """Subword tokenizers for the neural sentiment backends.
 
+Replaces nothing in the reference: its LLM path sends raw text to an
+Ollama server which tokenizes remotely (``scripts/sentiment_classifier.py:
+85-100``); on-device models need explicit tokenizers.
+
 This environment is zero-egress, so pretrained tokenizer assets may be
 absent.  Three tiers, best available wins:
 

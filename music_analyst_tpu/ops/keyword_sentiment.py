@@ -23,7 +23,6 @@ Semantics notes (SURVEY.md §5 contract #5):
 
 from __future__ import annotations
 
-from functools import partial
 from typing import List, Sequence, Tuple
 
 import jax

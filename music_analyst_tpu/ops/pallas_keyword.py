@@ -1,5 +1,9 @@
 """Pallas TPU kernel for the keyword-sentiment scan.
 
+Semantics source: the reference's ``--mock`` heuristic
+(``scripts/sentiment_classifier.py:66-83``), via the shared helpers in
+``ops/keyword_sentiment.py``.
+
 The XLA formulation (``ops/keyword_sentiment.py``) emits ~10 shifted
 compare/AND/OR chains over the byte matrix; XLA fuses them, but each
 keyword's chain re-reads the block from HBM unless the fusion heuristics

@@ -17,7 +17,6 @@ the full sequence anywhere.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

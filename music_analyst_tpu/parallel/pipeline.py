@@ -16,7 +16,6 @@ backward pass.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable
 
 import jax

@@ -1,5 +1,9 @@
 """Parameter partition rules: param-tree paths → ``PartitionSpec``.
 
+The reference has no tensor parallelism at all (SURVEY.md §2.4: DP via
+MPI byte-range sharding is its only axis); TP exists here for the
+Llama-family sentiment config the north star requires.
+
 The tensor-parallel layout follows the Megatron/scaling-book recipe: QKV
 projections split the *head* axis over ``tp`` and the output projection
 splits the *input* head axis (one all-reduce per attention block); MLP
@@ -12,7 +16,7 @@ hand-written collective in the model code.
 from __future__ import annotations
 
 import re
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P

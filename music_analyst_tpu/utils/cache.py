@@ -1,5 +1,10 @@
 """Persistent XLA compilation cache.
 
+No reference analogue: the reference recompiles nothing (ahead-of-time C
+binary) but also re-does its column-split preprocessing on every run
+(``src/parallel_spotify.c:821``); here the expensive per-run artifact is
+the XLA program, and it persists.
+
 First-compile latency (~1-2 s per program on v5e, more for big models)
 would otherwise be paid by every fresh process; with the persistent cache
 a cold CLI invocation reuses programs compiled by any earlier run.

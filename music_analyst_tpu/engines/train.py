@@ -198,11 +198,15 @@ def make_train_step(model, optimizer, mesh: Optional[Mesh] = None):
     # is an identity check, so the steady-state loop never re-derives the
     # layout (NamedSharding is hashable, so the cold-path key is the
     # sharding tuple itself, no string formatting).
+    import weakref
+
     jitted_by_layout = {}
-    last_out = [None, None]  # [output state, jitted fn that produced it]
+    # Weakref so the cache never pins the caller's dropped TrainState
+    # (params + both Adam moments) in device memory.
+    last_out = [None, None]  # [weakref to output state, jitted fn]
 
     def pinned_step(state, token_ids, lengths):
-        if state is last_out[0]:
+        if last_out[0] is not None and last_out[0]() is state:
             jitted = last_out[1]
         else:
             shardings = _shardings_of(state)
@@ -218,7 +222,7 @@ def make_train_step(model, optimizer, mesh: Optional[Mesh] = None):
                 )
                 jitted_by_layout[key] = jitted
         new_state, loss = jitted(state, token_ids, lengths)
-        last_out[0], last_out[1] = new_state, jitted
+        last_out[0], last_out[1] = weakref.ref(new_state), jitted
         return new_state, loss
 
     return pinned_step

@@ -62,6 +62,11 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = False,
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, S_loc, H, D = q.shape
+    # GQA: the blocks that ROTATE stay at their compact n_kv_heads size
+    # (ring ICI traffic is the scarce resource); the dense path broadcasts
+    # to the query-head count only transiently inside each hop, and the
+    # flash kernel maps query head -> kv head in its index map.
+    group = H // k.shape[2]
     q_pos = idx * S_loc + jnp.arange(S_loc)
 
     m = jnp.full((B, H, S_loc), _NEG_INF, jnp.float32)
@@ -95,8 +100,13 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = False,
             m = m_new
         else:
             kv_pos = owner * S_loc + jnp.arange(S_loc)
+            if group > 1:
+                k_use = jnp.repeat(k_blk, group, axis=2)
+                v_use = jnp.repeat(v_blk, group, axis=2)
+            else:
+                k_use, v_use = k_blk, v_blk
             m, l, o = _block_attn_update(
-                q, k_blk, v_blk, q_pos, kv_pos, causal, m, l, o
+                q, k_use, v_use, q_pos, kv_pos, causal, m, l, o
             )
         perm = [(i, (i + 1) % n) for i in range(n)]
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)

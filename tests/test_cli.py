@@ -89,3 +89,16 @@ def test_wordcount_per_song_command(fixture_csv, tmp_path):
     assert rc == 0
     assert (tmp_path / "word_counts_global.csv").exists()
     assert (tmp_path / "word_counts_by_song.csv").exists()
+
+
+def test_sweep_command(fixture_csv, tmp_path, capsys):
+    rc = main([
+        "sweep", str(fixture_csv), "--devices", "1,2",
+        "--output-dir", str(tmp_path),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "np=1" in out and "np=2" in out
+    assert (tmp_path / "sweep_summary.json").exists()
+    assert (tmp_path / "performance_metrics_np1.json").exists()
+    assert (tmp_path / "performance_metrics_np2.json").exists()

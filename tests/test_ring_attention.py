@@ -70,3 +70,19 @@ def test_flash_ring_matches_full_attention_causal(sp_mesh):
         ring_attention(q, k, v, sp_mesh, causal=True, use_flash=True)
     )
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_gqa_ring_matches_full_attention(sp_mesh):
+    rng = np.random.default_rng(5)
+    q, k, v = _rand_qkv(rng, B=2, S=64, H=8, D=16, kv_heads=2)
+    want = np.asarray(dot_product_attention(q, k, v))
+    got = np.asarray(ring_attention(q, k, v, sp_mesh))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_gqa_flash_ring_matches_full_attention(sp_mesh):
+    rng = np.random.default_rng(6)
+    q, k, v = _rand_qkv(rng, B=1, S=1024, H=4, D=64, kv_heads=2)
+    want = np.asarray(dot_product_attention(q, k, v))
+    got = np.asarray(ring_attention(q, k, v, sp_mesh, use_flash=True))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)

@@ -38,6 +38,9 @@ def _add_analyze(sub: argparse._SubParsersAction) -> None:
                         "(default) or scatter-add of device-resident ids")
     p.add_argument("--no-split", action="store_true",
                    help="Skip writing split_columns/ artifacts")
+    p.add_argument("--trace-dir", default=None,
+                   help="Capture an XLA/TPU profiler trace into this dir "
+                        "(TensorBoard/Perfetto-viewable)")
     p.add_argument("--devices", type=int, default=None,
                    help="Use only the first N devices of the mesh")
     p.add_argument("--with-sentiment", action="store_true",
@@ -65,6 +68,8 @@ def _add_sentiment(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--resume", action="store_true",
                    help="Continue from an interrupted run's "
                         "sentiment_details.csv")
+    p.add_argument("--trace-dir", default=None,
+                   help="Capture an XLA/TPU profiler trace into this dir")
 
 
 def _add_wordcount_per_song(sub: argparse._SubParsersAction) -> None:
@@ -138,53 +143,58 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     if args.command == "analyze":
+        from music_analyst_tpu.metrics.tracing import maybe_trace
         from music_analyst_tpu.parallel.mesh import data_parallel_mesh
 
         mesh = data_parallel_mesh(args.devices) if args.devices else None
         if args.with_sentiment:
             from music_analyst_tpu.engines.joint import run_joint
 
-            run_joint(
-                args.dataset,
-                output_dir=args.output_dir,
-                model=args.model,
-                mock=args.mock,
-                word_limit=args.word_limit,
-                artist_limit=args.artist_limit,
-                limit=args.limit,
-                batch_size=args.batch_size,
-                mesh=mesh,
-                write_split=not args.no_split,
-                ingest_backend=args.ingest,
-            )
+            with maybe_trace(args.trace_dir):
+                run_joint(
+                    args.dataset,
+                    output_dir=args.output_dir,
+                    model=args.model,
+                    mock=args.mock,
+                    word_limit=args.word_limit,
+                    artist_limit=args.artist_limit,
+                    limit=args.limit,
+                    batch_size=args.batch_size,
+                    mesh=mesh,
+                    write_split=not args.no_split,
+                    ingest_backend=args.ingest,
+                )
             return 0
         from music_analyst_tpu.engines.wordcount import run_analysis
 
-        run_analysis(
-            args.dataset,
-            output_dir=args.output_dir,
-            word_limit=args.word_limit,
-            artist_limit=args.artist_limit,
-            limit=args.limit,
-            mesh=mesh,
-            write_split=not args.no_split,
-            ingest_backend=args.ingest,
-            count_mode=args.count_mode,
-        )
+        with maybe_trace(args.trace_dir):
+            run_analysis(
+                args.dataset,
+                output_dir=args.output_dir,
+                word_limit=args.word_limit,
+                artist_limit=args.artist_limit,
+                limit=args.limit,
+                mesh=mesh,
+                write_split=not args.no_split,
+                ingest_backend=args.ingest,
+                count_mode=args.count_mode,
+            )
         return 0
 
     if args.command == "sentiment":
         from music_analyst_tpu.engines.sentiment import run_sentiment
+        from music_analyst_tpu.metrics.tracing import maybe_trace
 
-        run_sentiment(
-            args.dataset,
-            model=args.model,
-            mock=args.mock,
-            limit=args.limit,
-            output_dir=args.output_dir,
-            batch_size=args.batch_size,
-            resume=args.resume,
-        )
+        with maybe_trace(args.trace_dir):
+            run_sentiment(
+                args.dataset,
+                model=args.model,
+                mock=args.mock,
+                limit=args.limit,
+                output_dir=args.output_dir,
+                batch_size=args.batch_size,
+                resume=args.resume,
+            )
         return 0
 
     if args.command == "wordcount-per-song":

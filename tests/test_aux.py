@@ -102,3 +102,53 @@ def test_ollama_backend_contract(monkeypatch):
     assert calls["payload"]["model"] == "mymodel"
     assert "Lyrics:" in calls["payload"]["prompt"]
     assert clf.last_latencies[1] == 0.0
+
+
+def test_checkpoint_restores_across_mesh_layouts(tmp_path):
+    """A state saved from a dp×tp mesh restores onto a differently-factored
+    mesh (the elastic-resume contract the reference lacks entirely —
+    SURVEY.md §5 'Checkpoint/resume: none')."""
+    from music_analyst_tpu.engines.checkpoint import (
+        restore_train_state,
+        save_train_state,
+    )
+    from music_analyst_tpu.engines.train import (
+        init_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+    from music_analyst_tpu.models.llama import LlamaConfig, LlamaModel
+    from music_analyst_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    cfg = LlamaConfig(
+        vocab_size=256, dim=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        hidden_dim=64, rope_theta=1e4, max_seq_len=64, dtype="float32",
+    )
+    model = LlamaModel(cfg)
+    opt = make_optimizer()
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(1, 256, (8, 9)), jnp.int32)
+    lengths = jnp.full((8,), 9, jnp.int32)
+
+    mesh_a = build_mesh(MeshSpec((("dp", 4), ("tp", 2))))
+    state = init_train_state(model, opt, (ids, lengths), mesh=mesh_a,
+                             zero1=True)
+    step_a = make_train_step(model, opt, mesh=mesh_a)
+    state, loss_a = step_a(state, ids, lengths)
+    path = save_train_state(state, str(tmp_path / "ckpt"))
+
+    # Restore onto a different factoring: dp=2 × tp=4.
+    mesh_b = build_mesh(MeshSpec((("dp", 2), ("tp", 4))))
+    template = init_train_state(model, opt, (ids, lengths), mesh=mesh_b)
+    restored = restore_train_state(path, like=template)
+    leaf_a = state.params["layer_0"]["feed_forward"]["gate_proj"]["kernel"]
+    leaf_b = restored.params["layer_0"]["feed_forward"]["gate_proj"]["kernel"]
+    np.testing.assert_array_equal(np.asarray(leaf_a), np.asarray(leaf_b))
+
+    step_b = make_train_step(model, opt, mesh=mesh_b)
+    restored, loss_b = step_b(restored, ids, lengths)
+    assert np.isfinite(float(loss_b))
+    # Same data, same restored weights -> same loss on the new mesh as one
+    # more step on the old mesh.
+    state, loss_a2 = step_a(state, ids, lengths)
+    np.testing.assert_allclose(float(loss_b), float(loss_a2), rtol=1e-5)

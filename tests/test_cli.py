@@ -102,3 +102,14 @@ def test_sweep_command(fixture_csv, tmp_path, capsys):
     assert (tmp_path / "sweep_summary.json").exists()
     assert (tmp_path / "performance_metrics_np1.json").exists()
     assert (tmp_path / "performance_metrics_np2.json").exists()
+
+
+def test_analyze_trace_dir_writes_profile(fixture_csv, tmp_path, capsys):
+    rc = main([
+        "analyze", str(fixture_csv), "--output-dir", str(tmp_path / "out"),
+        "--no-split", "--trace-dir", str(tmp_path / "trace"),
+    ])
+    assert rc == 0
+    capsys.readouterr()
+    trace_files = list((tmp_path / "trace").rglob("*"))
+    assert any(f.is_file() for f in trace_files), trace_files

@@ -221,9 +221,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             no_header=args.no_header,
             force=args.force,
         )
-        print(f"Concluído. {len(names)} arquivo(s) gerado(s) em: {out_dir}")
+        print(f"Wrote {len(names)} column file(s) to {out_dir}:")
         for name in names:
-            print(f" - {out_dir / name}")
+            print(f"  {out_dir / name}")
         return 0
 
     return 1

@@ -54,6 +54,20 @@ def iter_songs(
             )
 
 
+def sniff_delimiter(sample: str, fallback: str = ",") -> str:
+    """Delimiter of a CSV sample via ``csv.Sniffer``.
+
+    Used by the per-song tool (reference
+    ``scripts/word_count_per_song.py:42-49`` sniffs a 64 KiB sample, comma
+    fallback).  The generic splitter needs the full dialect, not just the
+    delimiter — see ``data/splitter.py:_resolve_format``.
+    """
+    try:
+        return csv.Sniffer().sniff(sample).delimiter
+    except csv.Error:
+        return fallback
+
+
 def iter_csv_records_exact(data: bytes) -> Iterator[bytes]:
     """Split a CSV byte stream into records, quotes-aware.
 

@@ -16,8 +16,11 @@ from __future__ import annotations
 import csv
 import os
 import re
+from contextlib import ExitStack
+from dataclasses import dataclass
+from itertools import chain, count
 from pathlib import Path
-from typing import List, Optional, Tuple
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 from music_analyst_tpu.data.csv_io import (
     iter_csv_records_exact,
@@ -135,6 +138,68 @@ def read_header_labels(dataset_path: str) -> Tuple[str, str]:
     )
 
 
+@dataclass(frozen=True)
+class _ColumnCsvFormat:
+    """Dialect parameters shared by the generic splitter's reader and its
+    column writers (same-format round-trip is what keeps unquoted cells
+    unquoted and ``\\n`` line endings stable)."""
+
+    delimiter: str = ","
+    quotechar: str = '"'
+    skipinitialspace: bool = False
+
+    def dialect_kwargs(self) -> dict:
+        return dict(
+            delimiter=self.delimiter,
+            quotechar=self.quotechar,
+            doublequote=True,
+            skipinitialspace=self.skipinitialspace,
+            lineterminator="\n",
+            quoting=csv.QUOTE_MINIMAL,
+        )
+
+
+def _resolve_format(
+    fh, delimiter: Optional[str], quotechar: str
+) -> _ColumnCsvFormat:
+    """Explicit delimiter wins; otherwise sniff a 64 KiB sample and fall
+    back to commas (reference tool semantics, SURVEY.md §2.2 P9)."""
+    quote = quotechar or '"'
+    if delimiter:
+        return _ColumnCsvFormat(delimiter, quote)
+    mark = fh.tell()
+    sample = fh.read(65536)
+    fh.seek(mark)
+    try:
+        sniffed = csv.Sniffer().sniff(sample)
+    except csv.Error:
+        return _ColumnCsvFormat(",", quote)
+    return _ColumnCsvFormat(
+        sniffed.delimiter, quote, sniffed.skipinitialspace
+    )
+
+
+def _allocate_column_filenames(
+    headers: Sequence[str], out_dir: Path, force: bool
+) -> List[str]:
+    """``<sanitized>.csv`` per column; duplicates (case-insensitive) and
+    pre-existing files (unless ``force``) get ``_2, _3…`` suffixes."""
+    taken: set = set()
+    names: List[str] = []
+    for position, header in enumerate(headers, start=1):
+        base = sanitize_filename(str(header)) or f"col{position}"
+        for suffix in count(1):
+            name = f"{base}.csv" if suffix == 1 else f"{base}_{suffix}.csv"
+            blocked = name.lower() in taken or (
+                (out_dir / name).exists() and not force
+            )
+            if not blocked:
+                break
+        taken.add(name.lower())
+        names.append(name)
+    return names
+
+
 def split_csv_columns(
     csv_path: str,
     output_dir: Optional[str] = None,
@@ -146,107 +211,49 @@ def split_csv_columns(
 ) -> Tuple[Path, List[str]]:
     """Generic one-file-per-column splitter.
 
-    Behavioral clone of ``scripts/split_csv_columns.py:117-206``: sniffed
-    delimiter (64 KiB sample, fallback ``,``), sanitized header filenames
-    with ``_2, _3…`` collision suffixes, header row re-emitted into each
-    column file unless ``no_header``.
+    Capability parity with ``scripts/split_csv_columns.py`` (artifact
+    bytes pinned by ``tests/test_reference_scripts_differential.py``):
+    sanitized header filenames with collision suffixes, header row
+    re-emitted into each column file unless ``no_header``, short rows
+    padded with empty cells, surplus cells dropped.
     """
     in_path = Path(csv_path)
     if not in_path.exists():
         raise FileNotFoundError(str(in_path))
-    base_out = (
+    out_dir = (
         Path(output_dir)
         if output_dir
-        else in_path.with_suffix("").parent / f"{in_path.stem}_columns"
+        else in_path.parent / f"{in_path.stem}_columns"
     )
-    base_out.mkdir(parents=True, exist_ok=True)
+    out_dir.mkdir(parents=True, exist_ok=True)
 
     with open(in_path, "r", encoding=encoding, newline="") as fh:
-        if delimiter:
-            fmt = dict(
-                delimiter=delimiter,
-                quotechar=quotechar,
-                doublequote=True,
-                skipinitialspace=False,
-                lineterminator="\n",
-                quoting=csv.QUOTE_MINIMAL,
-            )
-        else:
-            pos = fh.tell()
-            sample = fh.read(65536)
-            fh.seek(pos)
-            try:
-                dialect = csv.Sniffer().sniff(sample)
-                fmt = dict(
-                    delimiter=dialect.delimiter,
-                    quotechar=quotechar or '"',
-                    doublequote=True,
-                    skipinitialspace=dialect.skipinitialspace,
-                    lineterminator="\n",
-                    quoting=csv.QUOTE_MINIMAL,
-                )
-            except csv.Error:
-                fmt = dict(
-                    delimiter=",",
-                    quotechar=quotechar or '"',
-                    doublequote=True,
-                    skipinitialspace=False,
-                    lineterminator="\n",
-                    quoting=csv.QUOTE_MINIMAL,
-                )
-        reader = csv.reader(fh, **fmt)
-        try:
-            first_row = next(reader)
-        except StopIteration:
-            raise ValueError("empty CSV")
-
+        fmt = _resolve_format(fh, delimiter, quotechar).dialect_kwargs()
+        rows: Iterator[List[str]] = csv.reader(fh, **fmt)
+        first = next(rows, None)
+        if first is None:
+            raise ValueError(f"{in_path} is empty")
         if no_header:
-            headers = [f"col{i + 1}" for i in range(len(first_row))]
-            first_data_row: Optional[List[str]] = first_row
+            headers = [f"col{i + 1}" for i in range(len(first))]
+            rows = chain([first], rows)  # first row is data, not labels
         else:
             headers = [
-                (h if h is not None and str(h).strip() else f"col{i + 1}")
-                for i, h in enumerate(first_row)
+                str(cell) if str(cell).strip() else f"col{i + 1}"
+                for i, cell in enumerate(first)
             ]
-            first_data_row = None
+        names = _allocate_column_filenames(headers, out_dir, force)
 
-        num_cols = len(headers)
-        seen: set = set()
-        filenames: List[str] = []
-        for i, h in enumerate(headers, start=1):
-            name = sanitize_filename(str(h)) or f"col{i}"
-            candidate = f"{name}.csv"
-            k = 2
-            while candidate.lower() in seen or (
-                (base_out / candidate).exists() and not force
-            ):
-                candidate = f"{name}_{k}.csv"
-                k += 1
-            seen.add(candidate.lower())
-            filenames.append(candidate)
-
-        files = []
-        writers = []
-        try:
-            for i in range(num_cols):
-                fh_out = open(base_out / filenames[i], "w", encoding=encoding, newline="")
-                writer = csv.writer(fh_out, **fmt)
+        with ExitStack() as stack:
+            sinks = []
+            for header, name in zip(headers, names):
+                sink_fh = stack.enter_context(
+                    open(out_dir / name, "w", encoding=encoding, newline="")
+                )
+                sink = csv.writer(sink_fh, **fmt)
                 if not no_header:
-                    writer.writerow([headers[i]])
-                files.append(fh_out)
-                writers.append(writer)
-            if first_data_row is not None:
-                for i in range(num_cols):
-                    writers[i].writerow(
-                        [first_data_row[i] if i < len(first_data_row) else ""]
-                    )
-            for row in reader:
-                for i in range(num_cols):
-                    writers[i].writerow([row[i] if i < len(row) else ""])
-        finally:
-            for fh_out in files:
-                try:
-                    fh_out.close()
-                except Exception:
-                    pass
-    return base_out, filenames
+                    sink.writerow([header])
+                sinks.append(sink)
+            for row in rows:
+                for i, sink in enumerate(sinks):
+                    sink.writerow([row[i] if i < len(row) else ""])
+    return out_dir, names

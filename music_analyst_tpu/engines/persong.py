@@ -1,49 +1,115 @@
 """Per-song word counts — the serial/threaded oracle tool.
 
-Behavioral clone of ``scripts/word_count_per_song.py`` (SURVEY.md §2.2
-P7/P8): Latin-1-aware regex tokenizer, thread-pool row processing, two
-artifacts — ``word_counts_by_song.csv`` streamed in row order and
-``word_counts_global.csv`` via ``Counter.most_common()`` (ties in insertion
-order, deliberately *not* the strcmp tie-break of the parallel engine —
+Capability parity with the reference's per-song counter
+(``scripts/word_count_per_song.py``, SURVEY.md §2.2 P7/P8): same two
+artifacts (``word_counts_by_song.csv`` streamed in row order,
+``word_counts_global.csv`` ranked count-desc with ties in first-seen
+order — deliberately *not* the strcmp tie-break of the parallel engine;
 that divergence exists in the reference and is preserved).
+
+The implementation follows this repo's histogram idiom rather than the
+reference's ``Counter``-based script: words get dense first-seen integer
+ids and fold into a flat count vector (the host-side analogue of
+``ops/histogram.py``'s vocab + dense-counts design), and the global
+ranking is a single stable sort on ``-count`` — which reproduces
+``Counter.most_common()`` tie order without materializing a ``Counter``.
+Tokenization runs on a chunked submit/collect thread pipeline (bounded
+in-flight window, results folded strictly in submission order), the same
+shape as the sentiment engine's batch pipeline.
 """
 
 from __future__ import annotations
 
 import csv
 import os
-from collections import Counter
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, Optional, Tuple
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
+from music_analyst_tpu.data.csv_io import sniff_delimiter
 from music_analyst_tpu.data.tokenizer import tokenize_latin1
 
+# Rows per pool task.  Large enough to amortize future/queue overhead,
+# small enough that the bounded window keeps memory flat on 1M-row files.
+_CHUNK_ROWS = 512
+# Chunks allowed in flight ahead of the fold (per worker).
+_WINDOW_PER_WORKER = 2
 
-def detect_delimiter(sample: str) -> str:
-    """``csv.Sniffer`` over the sample, fallback ``,`` (reference :42-49)."""
-    try:
-        return csv.Sniffer().sniff(sample).delimiter
-    except csv.Error:
-        return ","
-
-
-def resolve_workers(requested: int) -> int:
-    """0/negative → one thread per CPU (reference :84-88)."""
-    if requested and requested > 0:
-        return requested
-    return max(1, os.cpu_count() or 1)
+# One song's tokenization: (artist, song, ((word, count), ...)) with words
+# in first-appearance order, or None when the lyric produced no tokens.
+_SongCounts = Optional[Tuple[str, str, Tuple[Tuple[str, int], ...]]]
 
 
-def process_row(row: Dict[str, str]) -> Optional[Tuple[str, str, Counter]]:
-    """Tokenize one row; ``None`` when the lyric has no tokens (ref :91-99)."""
-    artist = (row.get("artist") or "").strip()
-    song = (row.get("song") or "").strip()
-    text = row.get("text") or ""
-    word_counter: Counter = Counter(tokenize_latin1(text))
-    if not word_counter:
-        return None
-    return artist, song, word_counter
+@dataclass
+class _DenseHistogram:
+    """Insertion-ordered word→count accumulator.
+
+    Host-side mirror of the device histogram design: a vocab dict handing
+    out dense first-seen ids plus a flat count vector, instead of the
+    reference's ``collections.Counter``.
+    """
+
+    ids: Dict[str, int] = field(default_factory=dict)
+    counts: List[int] = field(default_factory=list)
+
+    def add(self, word: str, n: int) -> None:
+        idx = self.ids.setdefault(word, len(self.counts))
+        if idx == len(self.counts):
+            self.counts.append(n)
+        else:
+            self.counts[idx] += n
+
+    def ranked(self) -> Iterator[Tuple[str, int]]:
+        """Count-desc; ties keep first-seen order (stable sort), matching
+        the ``most_common()`` semantics the reference's output exposes."""
+        order = sorted(range(len(self.counts)), key=lambda i: -self.counts[i])
+        words = list(self.ids)
+        return ((words[i], self.counts[i]) for i in order)
+
+    @property
+    def total(self) -> int:
+        return sum(self.counts)
+
+
+def _tokenize_chunk(
+    rows: Sequence[Tuple[str, str, str]],
+) -> List[_SongCounts]:
+    """Pool task: tokenize a block of (artist, song, text) rows.
+
+    Per-song word order is first-appearance order (dict insertion), which
+    both artifacts expose and the differential tests pin.
+    """
+    out: List[_SongCounts] = []
+    for artist, song, text in rows:
+        per_song: Dict[str, int] = {}
+        for token in tokenize_latin1(text):
+            per_song[token] = per_song.get(token, 0) + 1
+        out.append((artist, song, tuple(per_song.items())) if per_song else None)
+    return out
+
+
+def _iter_chunks(
+    reader: Iterable[Dict[str, str]], chunk_rows: int
+) -> Iterator[List[Tuple[str, str, str]]]:
+    chunk: List[Tuple[str, str, str]] = []
+    for row in reader:
+        # Short rows yield None for missing columns; treat them as empty
+        # (robustness divergence documented in MIGRATION.md — the
+        # reference would crash on None.strip()).
+        chunk.append(
+            (
+                (row.get("artist") or "").strip(),
+                (row.get("song") or "").strip(),
+                row.get("text") or "",
+            )
+        )
+        if len(chunk) >= chunk_rows:
+            yield chunk
+            chunk = []
+    if chunk:
+        yield chunk
 
 
 def run_per_song_wordcount(
@@ -54,7 +120,12 @@ def run_per_song_wordcount(
     workers: int = 0,
     quiet: bool = False,
 ) -> Tuple[Path, Path, int]:
-    """Write both artifacts; returns their paths and the row count."""
+    """Write both artifacts; returns (global_path, per_song_path, rows).
+
+    Artifact bytes match ``scripts/word_count_per_song.py`` exactly
+    (``tests/test_reference_scripts_differential.py``); the engine shape
+    does not.
+    """
     src = Path(csv_path)
     if not src.exists():
         raise FileNotFoundError(str(src))
@@ -63,41 +134,56 @@ def run_per_song_wordcount(
     global_path = out / "word_counts_global.csv"
     per_song_path = out / "word_counts_by_song.csv"
 
+    n_workers = workers if workers > 0 else max(1, os.cpu_count() or 1)
+    histogram = _DenseHistogram()
+    total_rows = 0
+
     with open(src, "r", encoding=encoding, newline="") as fh:
-        sample = fh.read(65536)
+        delim = delimiter or sniff_delimiter(fh.read(65536))
         fh.seek(0)
-        delim = delimiter or detect_delimiter(sample)
         reader = csv.DictReader(fh, delimiter=delim)
-        required = {"artist", "song", "text"}
-        if not required.issubset(reader.fieldnames or {}):
+        missing = {"artist", "song", "text"} - set(reader.fieldnames or ())
+        if missing:
             raise ValueError(
-                "CSV is missing expected columns: artist, song, text"
+                "CSV is missing expected columns: " + ", ".join(sorted(missing))
             )
 
-        global_counter: Counter = Counter()
-        total_rows = 0
-        with open(per_song_path, "w", encoding="utf-8", newline="") as ps_fh:
-            per_song_writer = csv.writer(ps_fh)
-            per_song_writer.writerow(["artist", "song", "word", "count"])
-            # Same split of work as the reference (:132-140): tokenization in
-            # the pool, the fold + write on the main thread, chunksize 32.
-            with ThreadPoolExecutor(max_workers=resolve_workers(workers)) as pool:
-                for result in pool.map(process_row, reader, chunksize=32):
+        with open(per_song_path, "w", encoding="utf-8", newline="") as ps_fh, \
+                ThreadPoolExecutor(max_workers=n_workers) as pool:
+            by_song = csv.writer(ps_fh)
+            by_song.writerow(["artist", "song", "word", "count"])
+
+            def fold(chunk_result: List[_SongCounts]) -> None:
+                nonlocal total_rows
+                for song_counts in chunk_result:
                     total_rows += 1
-                    if result is None:
+                    if song_counts is None:
                         continue
-                    artist, song, word_counter = result
-                    for word, count in word_counter.items():
-                        global_counter[word] += count
-                        per_song_writer.writerow([artist, song, word, count])
+                    artist, song, items = song_counts
+                    for word, count in items:
+                        histogram.add(word, count)
+                        by_song.writerow([artist, song, word, count])
+
+            # Bounded submit/collect window: tokenization overlaps the
+            # fold+write, results land strictly in submission order.
+            window: deque = deque()
+            for chunk in _iter_chunks(reader, _CHUNK_ROWS):
+                window.append(pool.submit(_tokenize_chunk, chunk))
+                if len(window) > n_workers * _WINDOW_PER_WORKER:
+                    fold(window.popleft().result())
+            while window:
+                fold(window.popleft().result())
 
     with open(global_path, "w", encoding="utf-8", newline="") as g_fh:
-        writer = csv.writer(g_fh)
-        writer.writerow(["word", "count"])
-        writer.writerows(global_counter.most_common())
+        ranked = csv.writer(g_fh)
+        ranked.writerow(["word", "count"])
+        ranked.writerows(histogram.ranked())
 
     if not quiet:
-        print("Concluído. Processadas", total_rows, "linhas. Arquivos gerados em", os.fspath(out))
-        print(" -", os.fspath(global_path))
-        print(" -", os.fspath(per_song_path))
+        print(
+            f"Processed {total_rows} row(s); "
+            f"{len(histogram.counts)} distinct words, {histogram.total} total."
+        )
+        print(f"  global ranking: {global_path}")
+        print(f"  per-song rows:  {per_song_path}")
     return global_path, per_song_path, total_rows

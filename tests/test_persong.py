@@ -3,30 +3,36 @@
 import csv
 from collections import Counter
 
+from music_analyst_tpu.data.csv_io import sniff_delimiter
 from music_analyst_tpu.data.tokenizer import tokenize_latin1
 from music_analyst_tpu.engines.persong import (
-    detect_delimiter,
-    process_row,
-    resolve_workers,
+    _DenseHistogram,
+    _tokenize_chunk,
     run_per_song_wordcount,
 )
 
 
-def test_detect_delimiter_fallback():
-    assert detect_delimiter("a;b;c\n1;2;3\n") == ";"
+def test_sniff_delimiter_fallback():
+    assert sniff_delimiter("a;b;c\n1;2;3\n") == ";"
     # empty sample raises csv.Error inside Sniffer -> fallback comma
-    assert detect_delimiter("") == ","
+    assert sniff_delimiter("") == ","
 
 
-def test_resolve_workers():
-    assert resolve_workers(4) == 4
-    assert resolve_workers(0) >= 1
+def test_tokenize_chunk_empty_tokens_none():
+    got = _tokenize_chunk(
+        [("A", "S", "a b c"), ("A", "S2", "hello hello world")]
+    )
+    assert got[0] is None  # no token reaches the >=3-char threshold
+    assert got[1] == ("A", "S2", (("hello", 2), ("world", 1)))
 
 
-def test_process_row_empty_tokens_none():
-    assert process_row({"artist": "A", "song": "S", "text": "a b c"}) is None
-    got = process_row({"artist": " A ", "song": "S", "text": "hello hello world"})
-    assert got == ("A", "S", Counter({"hello": 2, "world": 1}))
+def test_dense_histogram_most_common_semantics():
+    h = _DenseHistogram()
+    for word, n in [("bb", 1), ("aa", 2), ("cc", 1), ("bb", 1)]:
+        h.add(word, n)
+    # count desc, ties in first-seen order — Counter.most_common() order
+    assert list(h.ranked()) == [("bb", 2), ("aa", 2), ("cc", 1)]
+    assert h.total == 5
 
 
 def test_end_to_end(fixture_csv, tmp_path):
@@ -52,3 +58,22 @@ def test_end_to_end(fixture_csv, tmp_path):
         by_song = list(reader)
     total_from_rows = sum(int(c) for _, _, _, c in by_song)
     assert total_from_rows == sum(oracle.values())
+
+
+def test_small_chunks_keep_order(fixture_csv, tmp_path, monkeypatch):
+    """Chunked pipeline must fold in submission order regardless of chunk
+    size or worker count."""
+    import music_analyst_tpu.engines.persong as persong
+
+    monkeypatch.setattr(persong, "_CHUNK_ROWS", 2)
+    a = run_per_song_wordcount(
+        str(fixture_csv), output_dir=str(tmp_path / "a"), workers=4,
+        quiet=True,
+    )
+    monkeypatch.setattr(persong, "_CHUNK_ROWS", 512)
+    b = run_per_song_wordcount(
+        str(fixture_csv), output_dir=str(tmp_path / "b"), workers=1,
+        quiet=True,
+    )
+    for pa, pb in zip(a[:2], b[:2]):
+        assert open(pa, "rb").read() == open(pb, "rb").read()

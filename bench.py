@@ -2,26 +2,43 @@
 
 Headline metric (BASELINE.md): songs/sec sentiment-classified.  The driver
 target is all ~1M songs in < 60 s on a v5e-8 ⇒ ≥ ~16,667 songs/s pod-wide,
-i.e. ≥ ~2,083 songs/s *per chip*.  This bench runs the full-size
+i.e. ≥ ~2,083 songs/s *per chip*.  The measurement runs the full-size
 DistilBERT-sst2 architecture (66M params, seq len 128, bf16) end-to-end —
 host tokenization included — on however many chips are visible (one, under
 the round driver) and reports songs/sec with ``vs_baseline`` = measured /
 per-chip share of the target.
 
-Prints exactly ONE JSON line on stdout.
+Contract: prints exactly ONE JSON line on stdout, **including on failure**
+(``parsed`` must never be null again — round 1 lost its perf data to an
+UNAVAILABLE axon backend).  The measurement therefore runs in a child
+process: each attempt gets a fresh backend init (a failed `jax.devices()`
+poisons the parent's backend cache), transient UNAVAILABLE tunnel errors
+get bounded retries with backoff, and a terminal failure still emits the
+contractual line with an ``error`` field.
+
+Additional suites backing PERFORMANCE.md live in ``benchmarks/`` (see
+``python bench.py --list-suites``).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
 PER_CHIP_TARGET = 16_667 / 8  # songs/sec per chip for the <60s/1M goal
+METRIC = "sentiment_songs_per_sec_distilbert"
+# Backoff before retrying a failed attempt.  The axon loopback tunnel's
+# UNAVAILABLE is frequently transient but a wedged device lease can take
+# minutes to clear (CLAUDE.md), so the gaps grow aggressively.
+RETRY_SLEEPS = (20, 60, 180)
 
 
-def main() -> int:
+def measure() -> dict:
+    """One full measurement — runs inside the child process."""
     import jax
 
     from music_analyst_tpu.utils.cache import (
@@ -29,7 +46,9 @@ def main() -> int:
     )
 
     enable_persistent_compilation_cache()
-    n_chips = len(jax.devices())
+    devices = jax.devices()
+    n_chips = len(devices)
+    platform = devices[0].platform
 
     from music_analyst_tpu.data.synthetic import generate_dataset
     from music_analyst_tpu.data.csv_io import iter_songs
@@ -58,18 +77,108 @@ def main() -> int:
         pending = handle
         done += batch
     if pending is not None:
-        clf.collect(pending)
+        clf.collect(pending)  # np.asarray readback — reliable on axon
     elapsed = time.perf_counter() - start
 
     songs_per_sec = len(texts) / elapsed
-    result = {
-        "metric": "sentiment_songs_per_sec_distilbert",
+    return {
+        "metric": METRIC,
         "value": round(songs_per_sec, 1),
-        "unit": f"songs/sec on {n_chips} chip(s), seq128 bf16, host tokenize included",
+        "unit": (
+            f"songs/sec on {n_chips} {platform} chip(s), seq128 bf16, "
+            "host tokenize included"
+        ),
         "vs_baseline": round(songs_per_sec / (PER_CHIP_TARGET * n_chips), 3),
     }
-    print(json.dumps(result))
+
+
+def _run_child() -> int:
+    print(json.dumps(measure()))
     return 0
+
+
+def _last_json_line(text: str) -> dict | None:
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _run_parent(attempts: int) -> int:
+    last_error = "no attempts ran"
+    for attempt in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                capture_output=True,
+                text=True,
+                # Generous: first axon compile is slow and killing it can
+                # wedge the device lease — but a dead tunnel must not hang
+                # the driver forever.
+                timeout=600,
+            )
+        except subprocess.TimeoutExpired:
+            proc = None
+            last_error = "attempt timed out after 600s (tunnel hang?)"
+        if proc is not None:
+            result = (
+                _last_json_line(proc.stdout) if proc.returncode == 0 else None
+            )
+            if result is not None:
+                print(json.dumps(result))
+                return 0
+            tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+            last_error = (
+                " | ".join(tail[-3:]) if tail else f"rc={proc.returncode}"
+            )
+        # Backoff applies to timeouts too — killing a child mid-compile is
+        # exactly the case that wedges the lease and needs the longest gap.
+        if attempt + 1 < attempts:
+            time.sleep(RETRY_SLEEPS[min(attempt, len(RETRY_SLEEPS) - 1)])
+    # Terminal failure: still exactly one parseable JSON line.
+    print(
+        json.dumps(
+            {
+                "metric": METRIC,
+                "value": 0.0,
+                "unit": "songs/sec (benchmark failed; see error)",
+                "vs_baseline": 0.0,
+                "error": last_error[-800:],
+            }
+        )
+    )
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument(
+        "--attempts", type=int, default=4,
+        help="Max measurement attempts before emitting the error line",
+    )
+    parser.add_argument(
+        "--suite", default=None,
+        help="Run a PERFORMANCE.md suite from benchmarks/ instead of the "
+             "headline metric (see --list-suites)",
+    )
+    parser.add_argument("--list-suites", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_suites or args.suite:
+        from benchmarks import run_suite, suite_names
+
+        if args.list_suites:
+            print("\n".join(suite_names()))
+            return 0
+        return run_suite(args.suite)
+    if args.child:
+        return _run_child()
+    return _run_parent(args.attempts)
 
 
 if __name__ == "__main__":

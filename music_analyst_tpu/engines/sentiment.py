@@ -118,27 +118,25 @@ def _read_completed_details(details_path: str) -> Tuple[int, Dict[str, int]]:
     and appended onto.
     """
     with open(details_path, "rb+") as raw:
-        data = raw.read()
-        # Walk newlines backward from EOF until one sits at even quote
-        # parity ('""' escapes contribute two quotes, preserving parity):
-        # that's the last real row boundary.  parity(prefix) is derived
-        # from the total quote count minus an incrementally-grown suffix
-        # count, so only the (short) torn tail is rescanned — no second
-        # copy of a multi-GB details file is ever materialized.
-        total_quotes = data.count(b'"')
+        # One forward streaming pass in bounded chunks: a newline is a row
+        # boundary iff the quote count of the prefix ending there is even
+        # ('""' escapes contribute two quotes, preserving parity; a newline
+        # inside an open quoted field is row content).  Track the last such
+        # boundary — everything after it is the torn row.  No copy of a
+        # multi-GB details file is ever materialized.
         keep = 0
-        suffix_quotes = 0
-        pos = len(data)
-        while True:
-            nl = data.rfind(b"\n", 0, pos)
-            if nl < 0:
-                break
-            suffix_quotes += data.count(b'"', nl + 1, pos)
-            if (total_quotes - suffix_quotes) % 2 == 0:
-                keep = nl + 1
-                break
-            pos = nl
-        if keep != len(data):
+        quotes = 0
+        size = 0
+        while chunk := raw.read(1 << 22):
+            start = 0
+            while (nl := chunk.find(b"\n", start)) >= 0:
+                quotes += chunk.count(b'"', start, nl)
+                if quotes % 2 == 0:
+                    keep = size + nl + 1
+                start = nl + 1
+            quotes += chunk.count(b'"', start)
+            size += len(chunk)
+        if keep != size:
             raw.truncate(keep)
     done = 0
     counts: Dict[str, int] = {label: 0 for label in SUPPORTED_LABELS}

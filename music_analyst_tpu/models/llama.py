@@ -312,6 +312,22 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         self.config = config or LlamaConfig.tiny()
         self.max_prompt_len = max_prompt_len
         self.tokenizer = resolve_llama_tokenizer(self.config.vocab_size)
+        # Ids above vocab_size would be silently clamped by nn.Embed's
+        # gather, producing garbage labels with no diagnostic.  With real
+        # weights that's fatal; on random-weight smoke runs (labels are
+        # garbage regardless) a warning keeps e.g. --model llama3-tiny
+        # usable while MUSICAAL_LLAMA_TOKENIZER points at a full BPE dir.
+        if self.tokenizer.vocab_size > self.config.vocab_size:
+            message = (
+                f"tokenizer vocab ({self.tokenizer.vocab_size}) exceeds "
+                f"model vocab ({self.config.vocab_size})"
+            )
+            if checkpoint_path:
+                raise ValueError(message)
+            import warnings
+
+            warnings.warn(message + "; out-of-range ids will clamp",
+                          stacklevel=2)
         self.model = LlamaModel(self.config)
         dummy_ids = jnp.zeros((1, 8), jnp.int32)
         dummy_pos = jnp.zeros((1, 8), jnp.int32)
@@ -323,11 +339,6 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         if checkpoint_path:
             self.params = load_hf_torch_checkpoint(self.params, checkpoint_path)
             self.pretrained = True
-            if self.tokenizer.vocab_size > self.config.vocab_size:
-                raise ValueError(
-                    f"tokenizer vocab ({self.tokenizer.vocab_size}) exceeds "
-                    f"model vocab ({self.config.vocab_size})"
-                )
             if isinstance(self.tokenizer, ByteTokenizer):
                 import warnings
 

@@ -213,6 +213,28 @@ def _flash_call(
     return out.transpose(0, 2, 1, 3)  # back to [B, S, H, D]
 
 
+def _fit_block(requested: int, seq: int) -> int:
+    """Largest tile-aligned divisor of ``seq`` that is ≤ ``requested``.
+
+    Divisibility is required by the kernel's grid, but an over-large
+    request (e.g. the default 512 against S=768, or a ring shard that is
+    not a power of two) should degrade to a legal smaller block rather
+    than raise.  Only multiples of the 8-row TPU sublane tile qualify —
+    an unaligned block may not lower on real hardware and a tiny one is a
+    silent perf cliff — so genuinely awkward lengths still raise with the
+    remedy (sequences ≤ 8 pass through whole; they already fit one tile).
+    """
+    if seq <= 8:
+        return min(requested, seq)
+    for cand in range(min(requested, seq) // 8 * 8, 0, -8):
+        if seq % cand == 0:
+            return cand
+    raise ValueError(
+        f"no tile-aligned block ≤ {requested} divides sequence length "
+        f"{seq}; pad the sequence to a multiple of 8"
+    )
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -230,9 +252,11 @@ def flash_attention(
 
     ``lengths`` masks keys/values past each row's valid length (encoder
     padding); ``causal`` adds the autoregressive mask.  GQA is supported
-    when ``k``/``v`` carry fewer heads.  Sequence lengths must divide into
-    the block sizes; callers pad (the framework's batches are already
-    padded to static shapes).  Off-TPU the kernel runs in interpreter mode
+    when ``k``/``v`` carry fewer heads.  ``block_q``/``block_kv`` are upper
+    bounds: each is lowered to the largest divisor of its sequence length
+    (tile-aligned when possible), so non-power-of-two shards (e.g. ring
+    attention's per-device slices) pick a legal block instead of raising.
+    Off-TPU the kernel runs in interpreter mode
     so CPU test meshes exercise the same code path.
 
     ``q_offset``/``kv_offset`` shift the global positions used by the
@@ -244,13 +268,8 @@ def flash_attention(
     """
     B, S, H, D = q.shape
     KV = k.shape[1]
-    block_q = min(block_q, S)
-    block_kv = min(block_kv, KV)
-    if S % block_q or KV % block_kv:
-        raise ValueError(
-            f"seq lengths ({S}, {KV}) must be multiples of the block sizes "
-            f"({block_q}, {block_kv})"
-        )
+    block_q = _fit_block(block_q, S)
+    block_kv = _fit_block(block_kv, KV)
     if H % k.shape[2]:
         raise ValueError(f"q heads {H} not a multiple of kv heads {k.shape[2]}")
     if lengths is None:

@@ -87,7 +87,23 @@ def test_bf16_io():
     )
 
 
-def test_rejects_ragged_blocks():
-    q, k, v = _qkv(7, B=1, S=100, H=2, D=64)
-    with pytest.raises(ValueError, match="multiples"):
-        flash_attention(q, k, v, block_q=64, block_kv=64)
+def test_ragged_blocks_fall_back_to_divisors():
+    """Requested blocks that don't divide S degrade to a smaller
+    tile-aligned divisor (ADVICE r1: S=768 with the default block_q=512
+    used to raise) and stay correct."""
+    q, k, v = _qkv(7, B=1, S=768, H=2, D=64)
+    out = flash_attention(q, k, v, block_q=512, block_kv=512)
+    ref = dot_product_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_fit_block_tile_aligned_divisors_only():
+    from music_analyst_tpu.ops.flash_attention import _fit_block
+
+    assert _fit_block(512, 768) == 384   # largest 8-aligned divisor ≤ 512
+    assert _fit_block(512, 256) == 256   # exact fit
+    assert _fit_block(512, 7) == 7       # ≤ one tile: whole sequence
+    assert _fit_block(8, 1024) == 8
+    with pytest.raises(ValueError, match="pad the sequence"):
+        _fit_block(64, 100)              # no 8-aligned divisor exists

@@ -34,7 +34,7 @@ from music_analyst_tpu.metrics.perf import TimeStats, write_performance_metrics
 from music_analyst_tpu.metrics.timer import StageTimer
 from music_analyst_tpu.ops.histogram import (
     sharded_histogram,
-    sharded_histogram_hostlocal,
+    sharded_histogram_hostlocal_timed,
 )
 from music_analyst_tpu.parallel.mesh import data_parallel_mesh
 
@@ -47,6 +47,8 @@ class AnalysisResult:
     total_words: int
     timings: dict
     output_paths: dict
+    # Measured per-chip compute seconds (see per_chip in the metrics file).
+    per_chip_compute: List[float] = dataclasses.field(default_factory=list)
 
 
 def run_analysis(
@@ -91,6 +93,7 @@ def run_analysis(
     if mesh is None:
         mesh = data_parallel_mesh()
 
+    n_chips = mesh.devices.size
     with timer.stage("device_compute"):
         # np.asarray is the synchronization point: block_until_ready is not
         # reliable on every PJRT plugin, and the engine needs the host
@@ -100,19 +103,39 @@ def run_analysis(
         # the id stream to HBM and scatter-adds there — the right layout
         # when the ids are already device-resident (selectable via
         # ``analyze --count-mode``).
-        histogram = (
-            sharded_histogram_hostlocal
-            if count_mode == "host-shard"
-            else sharded_histogram
-        )
-        word_counts = np.asarray(
-            histogram(corpus.word_ids, max(1, len(corpus.word_vocab)), mesh)
-        )
-        artist_counts = np.asarray(
-            histogram(
+        if count_mode == "host-shard":
+            word_counts, word_times = sharded_histogram_hostlocal_timed(
+                corpus.word_ids, max(1, len(corpus.word_vocab)), mesh
+            )
+            artist_counts, artist_times = sharded_histogram_hostlocal_timed(
                 corpus.artist_ids, max(1, len(corpus.artist_vocab)), mesh
             )
-        )
+            # Shard i's measured compute: its own count phases plus the
+            # lock-stepped collective merges every chip sits in together.
+            per_chip_compute = [
+                w + a
+                for w, a in zip(
+                    word_times.per_chip_seconds(),
+                    artist_times.per_chip_seconds(),
+                )
+            ]
+        else:
+            word_counts = np.asarray(
+                sharded_histogram(
+                    corpus.word_ids, max(1, len(corpus.word_vocab)), mesh
+                )
+            )
+            artist_counts = np.asarray(
+                sharded_histogram(
+                    corpus.artist_ids, max(1, len(corpus.artist_vocab)), mesh
+                )
+            )
+            # One fused SPMD program: chips are lock-stepped, so each
+            # chip's compute IS the program wall-clock (documented
+            # TimeStats.uniform semantics).
+            per_chip_compute = None
+    if per_chip_compute is None:
+        per_chip_compute = [timer.seconds["device_compute"]] * n_chips
     # Grand totals are already global on the host (the reference needs an
     # MPI_Reduce only because each rank holds a partial count).
     total_words = corpus.token_count
@@ -132,8 +155,17 @@ def run_analysis(
 
     # Reference timing semantics (src/parallel_spotify.c:850-851,1000,1068):
     # compute = local read+count; total = compute + aggregation/export.
-    compute_seconds = timer.total("ingest", "device_compute")
-    total_seconds = timer.total("ingest", "device_compute", "aggregate_export")
+    # Each chip's compute = the shared host ingest (one pass serves every
+    # chip — the single-controller analogue of each rank's read) plus its
+    # own measured count/merge time, so the min/avg/max spread is real
+    # (cf. the reference's six MPI_Reduce stats, :1077-1082).
+    ingest_seconds = timer.seconds.get("ingest", 0.0)
+    export_seconds = timer.seconds.get("aggregate_export", 0.0)
+    per_chip_total = [ingest_seconds + c for c in per_chip_compute]
+    compute_time = TimeStats.from_samples(per_chip_total)
+    total_time = TimeStats.from_samples(
+        [c + export_seconds for c in per_chip_total]
+    )
     metrics_path = os.path.join(output_dir, "performance_metrics.json")
     devices = mesh.devices.flatten().tolist()
     write_performance_metrics(
@@ -141,15 +173,17 @@ def run_analysis(
         processes=len(devices),
         total_songs=total_songs,
         total_words=total_words,
-        compute_time=TimeStats.uniform(compute_seconds),
-        total_time=TimeStats.uniform(total_seconds),
+        compute_time=compute_time,
+        total_time=total_time,
         per_chip=[
             {
                 "device": str(d),
                 "platform": d.platform,
-                "compute_seconds": round(timer.seconds.get("device_compute", 0.0), 6),
+                # 9 decimals: the per-shard spread is microseconds on small
+                # corpora; 6 would round distinct measurements together.
+                "compute_seconds": round(seconds, 9),
             }
-            for d in devices
+            for d, seconds in zip(devices, per_chip_compute)
         ],
         stages=dict(timer.seconds),
         device_platform=devices[0].platform if devices else "unknown",
@@ -180,4 +214,5 @@ def run_analysis(
             "performance_metrics": metrics_path,
             "split_dir": split_dir,
         },
+        per_chip_compute=list(per_chip_compute),
     )

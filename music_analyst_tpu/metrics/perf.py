@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 
 @dataclasses.dataclass(frozen=True)
@@ -28,9 +28,19 @@ class TimeStats:
         The reference's per-rank min/avg/max spread comes from MPI ranks
         running asynchronously (``src/parallel_spotify.c:1077-1082``); a
         jitted SPMD program is lock-stepped across chips, so the three
-        statistics legitimately coincide.
+        statistics legitimately coincide.  Paths with genuinely per-chip
+        phases use :meth:`from_samples` instead.
         """
         return cls(seconds, seconds, seconds)
+
+    @classmethod
+    def from_samples(cls, samples: Sequence[float]) -> "TimeStats":
+        """min/avg/max over per-chip measurements — the honest analogue of
+        the reference's six ``MPI_Reduce`` timing statistics
+        (``src/parallel_spotify.c:1077-1082``)."""
+        if not samples:
+            return cls.uniform(0.0)
+        return cls(sum(samples) / len(samples), min(samples), max(samples))
 
     def as_dict(self) -> Dict[str, float]:
         return {

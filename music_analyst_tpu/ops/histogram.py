@@ -21,7 +21,10 @@ The Pallas budget went to the ops where explicit locality wins:
 
 from __future__ import annotations
 
-from functools import partial
+import dataclasses
+import time
+from functools import lru_cache, partial
+from typing import List, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -72,6 +75,46 @@ def _bucket_linear(n: int, step: int) -> int:
     return max(step, -(-n // step) * step)
 
 
+# --- compiled-collective cache -------------------------------------------
+#
+# The shard_map callables below are built once per (mesh, axis[, vocab]) and
+# memoized: constructing ``jax.jit(jax.shard_map(lambda ...))`` inside every
+# call would miss jit's own cache on every invocation (fresh lambda
+# identity) and re-trace — which made sweep wall-times compilation-bound
+# rather than scaling-meaningful.  ``Mesh`` is hashable by (devices, axis
+# names), so it is a sound cache key; the handful of meshes a process ever
+# builds bounds the cache.
+
+@lru_cache(maxsize=None)
+def _psum_ids_histogram(mesh: Mesh, axis: str, padded_vocab: int):
+    def local(x):
+        return jax.lax.psum(token_histogram(x, padded_vocab), axis)
+
+    return jax.jit(
+        jax.shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P())
+    )
+
+
+@lru_cache(maxsize=None)
+def _psum_rows(mesh: Mesh, axis: str):
+    def local(h):
+        return jax.lax.psum(h[0], axis)
+
+    return jax.jit(
+        jax.shard_map(local, mesh=mesh, in_specs=P(axis, None), out_specs=P())
+    )
+
+
+@lru_cache(maxsize=None)
+def _psum_scalar(mesh: Mesh, axis: str):
+    def local(x):
+        return jax.lax.psum(jnp.sum(x), axis)
+
+    return jax.jit(
+        jax.shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P())
+    )
+
+
 def sharded_histogram(
     ids: np.ndarray,
     vocab_size: int,
@@ -95,23 +138,33 @@ def sharded_histogram(
     padded[: ids.shape[0]] = ids
     padded = shard_pad(padded, mesh.shape[axis], PAD_ID)
     padded_vocab = _bucket(vocab_size, 1 << 10)
-    fn = jax.jit(
-        jax.shard_map(
-            lambda x: jax.lax.psum(token_histogram(x, padded_vocab), axis),
-            mesh=mesh,
-            in_specs=P(axis),
-            out_specs=P(),
-        )
-    )
-    return fn(padded)[:vocab_size]
+    return _psum_ids_histogram(mesh, axis, padded_vocab)(padded)[:vocab_size]
 
 
-def sharded_histogram_hostlocal(
+@dataclasses.dataclass(frozen=True)
+class HistogramTimings:
+    """Per-shard measured compute for the host-local histogram.
+
+    ``count_seconds[i]`` is shard *i*'s own counting wall-clock — the honest
+    analogue of each MPI rank timing its local count loop
+    (``src/parallel_spotify.c:850-851,1000``); they genuinely differ across
+    shards.  ``merge_seconds`` is the lock-stepped collective (every chip
+    spends it together — one SPMD program).
+    """
+
+    count_seconds: Tuple[float, ...]
+    merge_seconds: float
+
+    def per_chip_seconds(self) -> List[float]:
+        return [s + self.merge_seconds for s in self.count_seconds]
+
+
+def sharded_histogram_hostlocal_timed(
     ids: np.ndarray,
     vocab_size: int,
     mesh: Mesh,
     axis: str = "dp",
-) -> np.ndarray:
+) -> Tuple[np.ndarray, HistogramTimings]:
     """Histogram with host-local counting and a device ``psum`` merge.
 
     The locality structure of a multi-host deployment (and of the
@@ -121,25 +174,38 @@ def sharded_histogram_hostlocal(
     the token matrix has no other reason to be device-resident (the
     ``sharded_histogram`` ids-on-device path serves the joint pipeline,
     where it does).
+
+    Returns the counts plus measured :class:`HistogramTimings` (each
+    shard's count phase timed individually — the per-rank timing column the
+    metrics writer reports).
     """
     ids = np.asarray(ids, dtype=np.int32)
     shards = mesh.shape[axis]
     padded_vocab = _bucket(vocab_size, 1 << 10)
     chunks = np.array_split(ids, shards)
     local = np.zeros((shards, padded_vocab), dtype=np.int32)
+    count_seconds = []
     for i, chunk in enumerate(chunks):
+        t0 = time.perf_counter()
         valid = chunk[chunk >= 0]
         if valid.size:
             local[i] = np.bincount(valid, minlength=padded_vocab)
-    fn = jax.jit(
-        jax.shard_map(
-            lambda h: jax.lax.psum(h[0], axis),
-            mesh=mesh,
-            in_specs=P(axis, None),
-            out_specs=P(),
-        )
-    )
-    return np.asarray(fn(local))[:vocab_size]
+        count_seconds.append(time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    merged = np.asarray(_psum_rows(mesh, axis)(local))[:vocab_size]
+    merge_seconds = time.perf_counter() - t0
+    return merged, HistogramTimings(tuple(count_seconds), merge_seconds)
+
+
+def sharded_histogram_hostlocal(
+    ids: np.ndarray,
+    vocab_size: int,
+    mesh: Mesh,
+    axis: str = "dp",
+) -> np.ndarray:
+    """:func:`sharded_histogram_hostlocal_timed` without the timings."""
+    counts, _ = sharded_histogram_hostlocal_timed(ids, vocab_size, mesh, axis)
+    return counts
 
 
 def sharded_total(values: np.ndarray, mesh: Mesh, axis: str = "dp") -> int:
@@ -150,12 +216,4 @@ def sharded_total(values: np.ndarray, mesh: Mesh, axis: str = "dp") -> int:
     contributes zeros.
     """
     padded = shard_pad(np.asarray(values, dtype=np.int64), mesh.shape[axis], 0)
-    fn = jax.jit(
-        jax.shard_map(
-            lambda x: jax.lax.psum(jnp.sum(x), axis),
-            mesh=mesh,
-            in_specs=P(axis),
-            out_specs=P(),
-        )
-    )
-    return int(fn(padded))
+    return int(_psum_scalar(mesh, axis)(padded))

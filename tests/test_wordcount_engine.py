@@ -79,6 +79,25 @@ def test_metrics_schema(analysis):
     assert metrics["device_platform"] == "cpu"
 
 
+def test_per_chip_timings_are_measured_not_replicated(analysis):
+    """Each shard's count phase is timed individually (the reference's
+    per-rank MPI_Reduce stats, src/parallel_spotify.c:1077-1082) — the
+    per_chip column must NOT be one number copied per device."""
+    result, out, _ = analysis
+    metrics = json.loads((out / "performance_metrics.json").read_text())
+    per_chip = [entry["compute_seconds"] for entry in metrics["per_chip"]]
+    assert len(per_chip) == 8
+    assert all(s > 0 for s in per_chip)
+    # Eight independent perf_counter measurements of different shard sizes;
+    # identical-to-the-nanosecond values would mean replication, not
+    # measurement.
+    assert len(set(per_chip)) > 1
+    assert len(set(result.per_chip_compute)) > 1
+    # And the min/avg/max stats derive from that spread.
+    assert metrics["compute_time"]["min_seconds"] <= metrics["compute_time"]["avg_seconds"]
+    assert metrics["compute_time"]["max_seconds"] >= metrics["compute_time"]["avg_seconds"]
+
+
 def test_split_artifacts_written(analysis):
     _, out, _ = analysis
     split = out / "split_columns"

@@ -112,13 +112,21 @@ def run_analysis(
             )
             # Shard i's measured compute: its own count phases plus the
             # lock-stepped collective merges every chip sits in together.
-            per_chip_compute = [
+            per_shard = [
                 w + a
                 for w, a in zip(
                     word_times.per_chip_seconds(),
                     artist_times.per_chip_seconds(),
                 )
             ]
+            # One timing per dp shard; on a multi-axis mesh every device in
+            # a dp row shares its shard's time (the non-dp axes replicate
+            # the histogram work).  Map by each device's dp coordinate so
+            # per_chip always has exactly one entry per device.
+            dp_coord = np.indices(mesh.devices.shape)[
+                mesh.axis_names.index("dp")
+            ].flatten()
+            per_chip_compute = [per_shard[c] for c in dp_coord]
         else:
             word_counts = np.asarray(
                 sharded_histogram(

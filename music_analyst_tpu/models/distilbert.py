@@ -65,6 +65,7 @@ class TransformerBlock(nn.Module):
         dtype = jnp.dtype(cfg.dtype)
         attn_out = MultiHeadAttention(
             n_heads=cfg.n_heads, dtype=dtype, attn_impl=cfg.attn_impl,
+            use_bias=True,  # HF DistilBERT q/k/v/out projections have biases
             name="attention",
         )(x, mask=None if cfg.attn_impl == "flash" else mask,
           lengths=lengths)
@@ -115,15 +116,19 @@ def load_hf_torch_checkpoint(params, path: str):
     """Map an HF DistilBERT torch ``state_dict`` onto the Flax params.
 
     Accepts a ``pytorch_model.bin`` path; kernel matrices transpose
-    (torch Linear stores ``[out, in]``), attention projections reshape to
-    ``[dim, heads, head_dim]``.  Unmatched reference keys raise.
+    (torch Linear stores ``[out, in]``), attention projections (weights AND
+    biases) reshape to the ``[dim, heads, head_dim]`` head layout.  Every
+    checkpoint tensor must be consumed — leftover keys raise, so a
+    checkpoint with unexpected structure can never silently half-load.
     """
     import torch
 
     sd = torch.load(path, map_location="cpu", weights_only=True)
     cfg_heads = params["encoder"]["layer_0"]["attention"]["q_proj"]["kernel"].shape[1]
+    consumed = set()
 
     def t(name):
+        consumed.add(name)
         return np.asarray(sd[name].numpy())
 
     new = jax.tree_util.tree_map(lambda x: x, params)  # shallow copy
@@ -147,9 +152,13 @@ def load_hf_torch_checkpoint(params, path: str):
                              ("v_proj", "v_lin")):
             w = t(f"{hf}.attention.{theirs}.weight").T  # [in, out]
             attn[ours]["kernel"] = w.reshape(dim, cfg_heads, head_dim)
+            attn[ours]["bias"] = t(f"{hf}.attention.{theirs}.bias").reshape(
+                cfg_heads, head_dim
+            )
         attn["o_proj"]["kernel"] = (
             t(f"{hf}.attention.out_lin.weight").T.reshape(cfg_heads, head_dim, dim)
         )
+        attn["o_proj"]["bias"] = t(f"{hf}.attention.out_lin.bias")
         layer["sa_layer_norm"]["scale"] = t(f"{hf}.sa_layer_norm.weight")
         layer["sa_layer_norm"]["bias"] = t(f"{hf}.sa_layer_norm.bias")
         layer["ffn"]["lin1"]["kernel"] = t(f"{hf}.ffn.lin1.weight").T
@@ -162,11 +171,32 @@ def load_hf_torch_checkpoint(params, path: str):
     new["pre_classifier"]["bias"] = t("pre_classifier.bias")
     new["classifier"]["kernel"] = t("classifier.weight").T
     new["classifier"]["bias"] = t("classifier.bias")
+    # Non-parameter buffers some transformers versions serialize.
+    ignorable = {k for k in sd if k.endswith("position_ids")}
+    leftovers = set(sd) - consumed - ignorable
+    if leftovers:
+        raise ValueError(
+            "checkpoint keys not consumed by the DistilBERT mapping: "
+            + ", ".join(sorted(leftovers)[:8])
+        )
     return new
 
 
 class DistilBertClassifier(ClassifierBackend):
-    """Batched data-parallel sentiment backend."""
+    """Batched data-parallel sentiment backend.
+
+    ``neutral_threshold`` (default 0.6) is the 2→3-label calibration knob:
+    the sst2 head is binary, so its max softmax prob is ≥ 0.5 by
+    construction, and the band [0.5, threshold) — a logit margin under
+    ``ln(threshold/(1-threshold))``, ≈0.405 at 0.6 — is mapped to
+    ``Neutral``.  This mirrors the reference's behavior of bucketing every
+    non-committal model answer into Neutral (``utils/labels.py`` /
+    ``scripts/sentiment_classifier.py:101-107``): 0.6 keeps near-equipoise
+    lyrics out of Positive/Negative while letting any clear sst2 verdict
+    through.  It is a deployment knob, not a learned constant — the tested
+    contract (``tests/test_models.py``) is monotonicity: threshold 0.5
+    never yields Neutral on non-empty text, threshold 1.0 always does.
+    """
 
     name = "distilbert"
 

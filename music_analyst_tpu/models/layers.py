@@ -152,6 +152,9 @@ class MultiHeadAttention(nn.Module):
     # seq len must divide the kernel block size.
     attn_impl: str = "dense"
     flash_causal: bool = False
+    # BERT-family projections carry biases (HF q_lin/k_lin/v_lin/out_lin
+    # each have one); Llama-family does not.
+    use_bias: bool = False
 
     @nn.compact
     def __call__(
@@ -168,7 +171,7 @@ class MultiHeadAttention(nn.Module):
         dense = lambda feats, name: nn.DenseGeneral(  # noqa: E731
             features=feats,
             axis=-1,
-            use_bias=False,
+            use_bias=self.use_bias,
             dtype=self.dtype,
             name=name,
         )
@@ -214,7 +217,7 @@ class MultiHeadAttention(nn.Module):
         out = nn.DenseGeneral(
             features=features,
             axis=(-2, -1),
-            use_bias=False,
+            use_bias=self.use_bias,
             dtype=self.dtype,
             name="o_proj",
         )(out)

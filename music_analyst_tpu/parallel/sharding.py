@@ -26,6 +26,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 TP_RULES: List[Tuple[str, P]] = [
     # attention: kernel [dim, heads, head_dim] — shard heads
     (r".*(q_proj|k_proj|v_proj)/kernel$", P(None, "tp", None)),
+    # attention bias [heads, head_dim] (BERT family) — shard heads to match
+    (r".*(q_proj|k_proj|v_proj)/bias$", P("tp", None)),
     # output proj: kernel [heads, head_dim, dim] — shard input heads
     (r".*o_proj/kernel$", P("tp", None, None)),
     # gated MLP: [dim, hidden] / [hidden, dim]

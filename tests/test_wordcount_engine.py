@@ -105,6 +105,26 @@ def test_split_artifacts_written(analysis):
     assert (split / "text.csv").exists()
 
 
+def test_per_chip_column_covers_multi_axis_mesh(fixture_csv_module, tmp_path):
+    """On a dp×tp mesh the per_chip column still has one entry per DEVICE
+    (devices in a dp row share their shard's measured time)."""
+    from music_analyst_tpu.parallel.mesh import build_mesh, factor_devices
+
+    mesh = build_mesh(factor_devices(8, ("dp", "tp"), fixed={"tp": 2}))
+    result = run_analysis(
+        str(fixture_csv_module), output_dir=str(tmp_path), mesh=mesh,
+        quiet=True,
+    )
+    metrics = json.loads((tmp_path / "performance_metrics.json").read_text())
+    assert len(metrics["per_chip"]) == 8
+    assert len(result.per_chip_compute) == 8
+    per_chip = [e["compute_seconds"] for e in metrics["per_chip"]]
+    # 4 dp shards × 2 tp replicas: exactly 4 distinct shard timings, each
+    # appearing twice.
+    assert len(set(per_chip)) <= 4
+    assert sorted(per_chip.count(v) for v in set(per_chip)) == [2] * len(set(per_chip))
+
+
 def test_word_limit_truncates(fixture_csv_module, tmp_path):
     result = run_analysis(
         str(fixture_csv_module),

@@ -121,17 +121,14 @@ def clean_field(raw: bytes, preserve_outer_quotes: bool = False) -> bytes:
     return out.strip(C_WHITESPACE)
 
 
-def parse_record_exact(
+def _split_record_fields(
     record: bytes,
-    preserve_artist_quotes: bool = False,
-    preserve_text_quotes: bool = False,
-) -> Optional[Tuple[bytes, bytes]]:
-    """Extract ``(artist, text)`` from one record, reference semantics.
+) -> Optional[Tuple[bytes, bytes, bytes, bytes]]:
+    """Split one record at its first three unquoted commas.
 
-    Reference ``src/parallel_spotify.c:258-304``: split on unquoted commas;
-    field 0 is the artist; the *text* is everything after the third unquoted
-    comma (untouched — it may itself contain unquoted commas).  Records with
-    fewer than three unquoted commas are rejected (``None``).
+    Returns raw ``(field0, field1, field2, rest)`` or ``None`` for records
+    with fewer than three unquoted commas (the reference rejects them,
+    ``src/parallel_spotify.c:258-304``).
     """
     line = record.rstrip(b"\r\n")
     fields: List[bytes] = []
@@ -154,27 +151,71 @@ def parse_record_exact(
         i += 1
     if len(fields) < 3:
         return None
-    rest = line[start:]
+    return fields[0], fields[1], fields[2], line[start:]
+
+
+def parse_record_exact(
+    record: bytes,
+    preserve_artist_quotes: bool = False,
+    preserve_text_quotes: bool = False,
+) -> Optional[Tuple[bytes, bytes]]:
+    """Extract ``(artist, text)`` from one record, reference semantics.
+
+    Reference ``src/parallel_spotify.c:258-304``: split on unquoted commas;
+    field 0 is the artist; the *text* is everything after the third unquoted
+    comma (untouched — it may itself contain unquoted commas).  Records with
+    fewer than three unquoted commas are rejected (``None``).
+    """
+    split = _split_record_fields(record)
+    if split is None:
+        return None
+    field0, _, _, rest = split
     return (
-        clean_field(fields[0], preserve_artist_quotes),
+        clean_field(field0, preserve_artist_quotes),
         clean_field(rest, preserve_text_quotes),
     )
 
 
-def iter_dataset_exact(data: bytes) -> Iterator[Tuple[bytes, bytes]]:
-    """Yield ``(artist, text)`` for every data record, skipping the header.
+def parse_record_fields(
+    record: bytes,
+) -> Optional[Tuple[bytes, bytes, bytes]]:
+    """Extract cleaned ``(artist, song, text)`` from one record.
 
-    Drives :func:`iter_csv_records_exact` + :func:`parse_record_exact` the
-    way the reference's splitter does (``src/parallel_spotify.c:690-714``):
-    the first record is the header, empty and unparseable records are
-    skipped.
+    Same splitting/cleaning semantics as :func:`parse_record_exact`, plus
+    the *song* column (field 1) — the fused joint pipeline classifies
+    sentiment from the very records the histogram pass parsed, and its
+    details CSV needs the song title.
     """
+    split = _split_record_fields(record)
+    if split is None:
+        return None
+    field0, field1, _, rest = split
+    return clean_field(field0), clean_field(field1), clean_field(rest)
+
+
+def _iter_data_records(data: bytes) -> Iterator[bytes]:
+    """Every non-blank data record (header skipped) — the reference's
+    record-skip semantics (``src/parallel_spotify.c:690-714``), shared by
+    the two dataset iterators below so they can never drift apart."""
     records = iter_csv_records_exact(data)
     next(records, None)  # header
     for record in records:
-        if not record.strip(b"\r\n"):
-            continue
+        if record.strip(b"\r\n"):
+            yield record
+
+
+def iter_dataset_exact(data: bytes) -> Iterator[Tuple[bytes, bytes]]:
+    """Yield ``(artist, text)`` for every parseable data record."""
+    for record in _iter_data_records(data):
         parsed = parse_record_exact(record)
+        if parsed is not None:
+            yield parsed
+
+
+def iter_dataset_fields(data: bytes) -> Iterator[Tuple[bytes, bytes, bytes]]:
+    """Yield cleaned ``(artist, song, text)`` for every parseable record."""
+    for record in _iter_data_records(data):
+        parsed = parse_record_fields(record)
         if parsed is not None:
             yield parsed
 

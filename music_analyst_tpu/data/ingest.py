@@ -18,11 +18,11 @@ Backends: ``python`` (reference-exact oracle, this module) and ``native``
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional
+from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from music_analyst_tpu.data.csv_io import iter_dataset_exact
+from music_analyst_tpu.data.csv_io import iter_dataset_fields
 from music_analyst_tpu.data.tokenizer import tokenize_ascii
 from music_analyst_tpu.data.vocab import Vocab
 
@@ -37,6 +37,13 @@ class IngestResult:
     artist_vocab: Vocab
     artist_ids: np.ndarray     # int32 [songs], -1 for empty artist
     song_count: int
+    # Optional captured records (``capture_records=True``): cleaned
+    # artist/song/text bytes concatenated in record order; ``record_offsets``
+    # holds 3*songs+1 cumulative field ends.  Kept as one arena + offsets —
+    # NOT per-record Python strings — so a 1M-song capture costs one blob,
+    # and rows decode lazily per batch.
+    records_blob: Optional[bytes] = None
+    record_offsets: Optional[np.ndarray] = None
 
     @property
     def token_count(self) -> int:
@@ -45,10 +52,40 @@ class IngestResult:
     def tokens_per_song(self) -> np.ndarray:
         return np.diff(self.word_offsets)
 
+    @property
+    def has_records(self) -> bool:
+        return self.records_blob is not None
+
+    def record(self, i: int) -> Tuple[str, str, str]:
+        """Decoded ``(artist, song, text)`` for song ``i``."""
+        if not self.has_records:
+            raise ValueError(
+                "records were not captured; ingest with capture_records=True"
+            )
+        off = self.record_offsets
+        start = int(off[3 * i])
+        a_end, s_end, t_end = (int(off[3 * i + f + 1]) for f in range(3))
+        blob = self.records_blob
+        return (
+            blob[start:a_end].decode("utf-8", errors="replace"),
+            blob[a_end:s_end].decode("utf-8", errors="replace"),
+            blob[s_end:t_end].decode("utf-8", errors="replace"),
+        )
+
+    def iter_records(self) -> Iterator[Tuple[str, str, str]]:
+        """Lazily decode every captured ``(artist, song, text)`` row."""
+        if not self.has_records:
+            raise ValueError(
+                "records were not captured; ingest with capture_records=True"
+            )
+        for i in range(self.song_count):
+            yield self.record(i)
+
 
 def ingest_python(
     data: bytes,
     limit: Optional[int] = None,
+    capture_records: bool = False,
 ) -> IngestResult:
     """Pure-Python reference-exact ingest (oracle for the native path)."""
     word_vocab = Vocab()
@@ -57,8 +94,12 @@ def ingest_python(
     ids: List[int] = []
     offsets: List[int] = [0]
     artist_ids: List[int] = []
-    for i, (artist_raw, text_raw) in enumerate(iter_dataset_exact(data)):
-        if limit is not None and i >= limit:
+    blob = bytearray() if capture_records else None
+    rec_offsets: List[int] = [0] if capture_records else []
+    for parsed, (artist_raw, song_raw, text_raw) in enumerate(
+        iter_dataset_fields(data)
+    ):
+        if limit is not None and parsed >= limit:
             break
         ids.extend(word_add(tok) for tok in tokenize_ascii(text_raw))
         offsets.append(len(ids))
@@ -67,6 +108,10 @@ def ingest_python(
             artist_ids.append(artist_vocab.add(artist))
         else:
             artist_ids.append(-1)
+        if capture_records:
+            for field in (artist_raw, song_raw, text_raw):
+                blob.extend(field)
+                rec_offsets.append(len(blob))
     return IngestResult(
         word_vocab=word_vocab,
         word_ids=np.asarray(ids, dtype=np.int32),
@@ -74,6 +119,12 @@ def ingest_python(
         artist_vocab=artist_vocab,
         artist_ids=np.asarray(artist_ids, dtype=np.int32),
         song_count=len(artist_ids),
+        records_blob=bytes(blob) if capture_records else None,
+        record_offsets=(
+            np.asarray(rec_offsets, dtype=np.int64)
+            if capture_records
+            else None
+        ),
     )
 
 
@@ -82,15 +133,26 @@ def ingest_dataset(
     limit: Optional[int] = None,
     backend: str = "auto",
     num_threads: int = 0,
+    capture_records: bool = False,
 ) -> IngestResult:
-    """Ingest a dataset CSV with the requested backend."""
+    """Ingest a dataset CSV with the requested backend.
+
+    ``capture_records=True`` additionally retains every cleaned
+    ``(artist, song, text)`` row in an arena (see ``IngestResult``) so the
+    joint pipeline can feed sentiment from the same single parse.
+    """
     if backend not in ("auto", "python", "native"):
         raise ValueError(f"unknown ingest backend: {backend}")
     if backend in ("auto", "native"):
         from music_analyst_tpu.data import native
 
         if native.available():
-            return native.ingest_native(path, limit=limit, num_threads=num_threads)
+            return native.ingest_native(
+                path,
+                limit=limit,
+                num_threads=num_threads,
+                capture_records=capture_records,
+            )
         if backend == "native":
             raise RuntimeError(
                 "native ingest requested but the C++ library is unavailable "
@@ -98,4 +160,4 @@ def ingest_dataset(
             )
     with open(path, "rb") as fh:
         data = fh.read()
-    return ingest_python(data, limit=limit)
+    return ingest_python(data, limit=limit, capture_records=capture_records)

@@ -68,6 +68,15 @@ def _bind(lib: ctypes.CDLL) -> None:
     """Declare every exported symbol's signature (raises if one is absent)."""
     lib.man_ingest.restype = ctypes.c_void_p
     lib.man_ingest.argtypes = [ctypes.c_char_p, ctypes.c_longlong, ctypes.c_int]
+    lib.man_ingest_v2.restype = ctypes.c_void_p
+    lib.man_ingest_v2.argtypes = [
+        ctypes.c_char_p, ctypes.c_longlong, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.man_records_bytes.restype = ctypes.c_longlong
+    lib.man_records_bytes.argtypes = [ctypes.c_void_p]
+    lib.man_copy_records.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ]
     lib.man_error.restype = ctypes.c_char_p
     lib.man_error.argtypes = [ctypes.c_void_p]
     lib.man_song_count.restype = ctypes.c_longlong
@@ -192,7 +201,12 @@ def split_columns_native(
     return True
 
 
-def ingest_native(path: str, limit: Optional[int] = None, num_threads: int = 0):
+def ingest_native(
+    path: str,
+    limit: Optional[int] = None,
+    num_threads: int = 0,
+    capture_records: bool = False,
+):
     """Run the C++ ingest and wrap the results as an :class:`IngestResult`."""
     from music_analyst_tpu.data.ingest import IngestResult
     from music_analyst_tpu.data.vocab import Vocab
@@ -200,10 +214,11 @@ def ingest_native(path: str, limit: Optional[int] = None, num_threads: int = 0):
     lib = _load()
     if lib is None:
         raise RuntimeError(f"native library unavailable: {_load_error}")
-    handle = lib.man_ingest(
+    handle = lib.man_ingest_v2(
         path.encode("utf-8"),
         ctypes.c_longlong(-1 if limit is None else limit),
         ctypes.c_int(num_threads),
+        ctypes.c_int(1 if capture_records else 0),
     )
     if not handle:
         raise RuntimeError("native ingest failed to allocate")
@@ -245,6 +260,16 @@ def ingest_native(path: str, limit: Optional[int] = None, num_threads: int = 0):
             lib.man_artist_vocab_bytes(handle),
             lib.man_copy_artist_vocab,
         )
+        records_blob = None
+        record_offsets = None
+        if capture_records:
+            n_bytes = lib.man_records_bytes(handle)
+            buf = ctypes.create_string_buffer(max(1, n_bytes))
+            record_offsets = np.empty(3 * songs + 1, dtype=np.int64)
+            lib.man_copy_records(
+                handle, buf, record_offsets.ctypes.data_as(ctypes.c_void_p)
+            )
+            records_blob = buf.raw[:n_bytes]
         return IngestResult(
             word_vocab=Vocab(word_tokens),
             word_ids=word_ids,
@@ -252,6 +277,8 @@ def ingest_native(path: str, limit: Optional[int] = None, num_threads: int = 0):
             artist_vocab=Vocab(artist_tokens),
             artist_ids=artist_ids,
             song_count=int(songs),
+            records_blob=records_blob,
+            record_offsets=record_offsets,
         )
     finally:
         lib.man_free(handle)

@@ -21,7 +21,7 @@ import dataclasses
 import json
 import os
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from music_analyst_tpu.data.csv_io import iter_songs
 from music_analyst_tpu.utils.labels import SUPPORTED_LABELS
@@ -159,6 +159,7 @@ def run_sentiment(
     backend: Optional[ClassifierBackend] = None,
     quiet: bool = False,
     resume: bool = False,
+    songs: Optional[Iterable[Tuple[str, str, str]]] = None,
 ) -> SentimentResult:
     """Classify the dataset and write the reference output artifacts.
 
@@ -167,6 +168,11 @@ def run_sentiment(
     it (skipping already-classified rows and seeding the totals).  The
     reference has no recovery at all — every failure recomputes from the CSV
     (SURVEY.md §5 "Checkpoint/resume: none").
+
+    ``songs`` overrides the dataset read with an already-parsed iterable of
+    ``(artist, song, text)`` rows — the fused joint pipeline passes the
+    records its single ingest captured, so the file is opened once per run
+    (``limit`` is ignored then; the producer already applied it).
     """
     os.makedirs(output_dir, exist_ok=True)
     if backend is None:
@@ -252,10 +258,11 @@ def run_sentiment(
             finish(*in_flight)
         in_flight = pending
 
+    source = (
+        songs if songs is not None else iter_songs(dataset_path, limit=limit)
+    )
     try:
-        for idx, (artist, song, text) in enumerate(
-            iter_songs(dataset_path, limit=limit)
-        ):
+        for idx, (artist, song, text) in enumerate(source):
             if idx < skip:
                 continue
             batch.append((artist, song, text))

@@ -239,12 +239,17 @@ struct ThreadOut {
   std::vector<int32_t> word_ids;        // local word ids, record order
   std::vector<int64_t> tokens_per_song;
   std::vector<int32_t> artist_local;    // local artist ids, -1 = empty
+  // Optional record capture for the fused joint pipeline: cleaned
+  // artist/song/text bytes concatenated, 3 lengths per parsed song.
+  bool capture = false;
+  std::string rec_blob;
+  std::vector<uint32_t> field_lens;
 };
 
 void process_records(const char* data, const std::vector<size_t>& starts,
                      const std::vector<size_t>& ends, size_t rec_begin,
                      size_t rec_end, ThreadOut* out) {
-  std::string artist, text, token;
+  std::string artist, song, text, token;
   for (size_t r = rec_begin; r < rec_end; ++r) {
     const char* rec = data + starts[r];
     size_t len = ends[r] + 1 - starts[r];
@@ -254,7 +259,7 @@ void process_records(const char* data, const std::vector<size_t>& starts,
     // Split on unquoted commas; text = everything after the third comma
     // (csv_io.parse_record_exact semantics).
     size_t commas = 0;
-    size_t field0_end = SIZE_MAX, text_begin = SIZE_MAX;
+    size_t field0_end = SIZE_MAX, field1_end = SIZE_MAX, text_begin = SIZE_MAX;
     bool in_q = false;
     for (size_t i = 0; i < len; ++i) {
       char c = rec[i];
@@ -266,6 +271,7 @@ void process_records(const char* data, const std::vector<size_t>& starts,
         }
       } else if (c == ',' && !in_q) {
         if (commas == 0) field0_end = i;
+        else if (commas == 1) field1_end = i;
         ++commas;
         if (commas == 3) {
           text_begin = i + 1;
@@ -277,6 +283,16 @@ void process_records(const char* data, const std::vector<size_t>& starts,
 
     clean_field(rec, field0_end, false, &artist);
     clean_field(rec + text_begin, len - text_begin, false, &text);
+    if (out->capture) {
+      clean_field(rec + field0_end + 1, field1_end - field0_end - 1, false,
+                  &song);
+      out->rec_blob.append(artist);
+      out->rec_blob.append(song);
+      out->rec_blob.append(text);
+      out->field_lens.push_back((uint32_t)artist.size());
+      out->field_lens.push_back((uint32_t)song.size());
+      out->field_lens.push_back((uint32_t)text.size());
+    }
 
     // Tokenize (tokenizer.tokenize_ascii semantics: runs of
     // [0-9A-Za-z'], >= 3 bytes, ASCII-lowercased).
@@ -312,9 +328,14 @@ struct IngestHandle {
   std::vector<int32_t> artist_ids;
   Interner words{1 << 16};
   Interner artists{1 << 12};
+  // Captured records (fused joint pipeline): cleaned artist/song/text
+  // bytes, record order; rec_offsets has 3*songs+1 cumulative entries.
+  std::string rec_blob;
+  std::vector<int64_t> rec_offsets;
 };
 
-IngestHandle* ingest(const char* path, long long limit, int num_threads) {
+IngestHandle* ingest(const char* path, long long limit, int num_threads,
+                     bool capture_records) {
   auto* h = new IngestHandle();
   FILE* fp = fopen(path, "rb");
   if (!fp) {
@@ -357,6 +378,7 @@ IngestHandle* ingest(const char* path, long long limit, int num_threads) {
   std::vector<std::thread> pool;
   size_t per = total_records / threads + 1;
   for (unsigned t = 0; t < threads; ++t) {
+    outs[t].capture = capture_records;
     size_t rb = first + std::min((size_t)t * per, total_records);
     size_t re = first + std::min((size_t)(t + 1) * per, total_records);
     pool.emplace_back(process_records, data.data(), std::cref(starts),
@@ -365,6 +387,12 @@ IngestHandle* ingest(const char* path, long long limit, int num_threads) {
   for (auto& th : pool) th.join();
 
   // Phase 3: merge vocabularies, remap ids, concatenate in record order.
+  if (capture_records) {
+    h->rec_offsets.push_back(0);
+    size_t total_blob = 0;
+    for (const auto& out : outs) total_blob += out.rec_blob.size();
+    h->rec_blob.reserve(total_blob);
+  }
   for (auto& out : outs) {
     std::vector<int32_t> word_remap(out.words.count);
     for (size_t i = 0; i < out.words.count; ++i) {
@@ -379,6 +407,7 @@ IngestHandle* ingest(const char* path, long long limit, int num_threads) {
       artist_remap[i] = h->artists.intern(k, n);
     }
     size_t id_cursor = 0;
+    size_t blob_cursor = 0;
     for (size_t s = 0; s < out.tokens_per_song.size(); ++s) {
       if (limit >= 0 && (long long)h->artist_ids.size() >= limit) break;
       int64_t n_tokens = out.tokens_per_song[s];
@@ -388,7 +417,19 @@ IngestHandle* ingest(const char* path, long long limit, int num_threads) {
       id_cursor += (size_t)n_tokens;
       int32_t a = out.artist_local[s];
       h->artist_ids.push_back(a < 0 ? -1 : artist_remap[a]);
+      if (capture_records) {
+        for (size_t f = 0; f < 3; ++f) {
+          uint32_t flen = out.field_lens[3 * s + f];
+          h->rec_blob.append(out.rec_blob, blob_cursor, flen);
+          blob_cursor += flen;
+          h->rec_offsets.push_back((int64_t)h->rec_blob.size());
+        }
+      }
     }
+    // Each thread's capture buffer is dead once merged; free it eagerly so
+    // the peak is ~2x the captured text, not 3x (1M-song joint runs hold
+    // hundreds of MB here).
+    std::string().swap(out.rec_blob);
   }
   h->word_offsets.reserve(h->artist_ids.size() + 1);
   h->word_offsets.push_back(0);
@@ -418,7 +459,26 @@ IngestHandle* ingest(const char* path, long long limit, int num_threads) {
 extern "C" {
 
 void* man_ingest(const char* path, long long limit, int num_threads) {
-  return ingest(path, limit, num_threads);
+  return ingest(path, limit, num_threads, /*capture_records=*/false);
+}
+
+// v2 adds record capture for the fused joint pipeline (one parse feeds
+// both the histogram arrays and the sentiment batches).
+void* man_ingest_v2(const char* path, long long limit, int num_threads,
+                    int capture_records) {
+  return ingest(path, limit, num_threads, capture_records != 0);
+}
+
+long long man_records_bytes(void* handle) {
+  return (long long)((IngestHandle*)handle)->rec_blob.size();
+}
+
+// blob: rec_blob bytes; offsets: int64[3*songs+1] cumulative field ends.
+void man_copy_records(void* handle, char* blob, long long* offsets) {
+  auto* h = (IngestHandle*)handle;
+  memcpy(blob, h->rec_blob.data(), h->rec_blob.size());
+  memcpy(offsets, h->rec_offsets.data(),
+         h->rec_offsets.size() * sizeof(int64_t));
 }
 
 const char* man_error(void* handle) {

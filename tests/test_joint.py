@@ -2,6 +2,8 @@
 
 import json
 
+import pytest
+
 from music_analyst_tpu.cli.main import main
 from music_analyst_tpu.engines.joint import run_joint
 
@@ -22,8 +24,43 @@ def test_joint_writes_all_artifacts(fixture_csv, tmp_path):
     assert "sentiment" in metrics["stages"]
     assert "ingest" in metrics["stages"]
     assert result.analysis.total_songs == 7
-    assert sum(result.sentiment.counts.values()) == 8  # DictReader rows
+    # Fused pipeline: ONE parse, one parser, one consistent song count
+    # (the pre-fusion 7-vs-8 split between the exact parser and the
+    # DictReader re-read is gone inside a joint run).
+    assert sum(result.sentiment.counts.values()) == 7
+    assert len(result.sentiment.rows) == result.analysis.total_songs
     assert result.songs_per_second > 0
+    # The per-chip column carries the wordcount engine's measured per-chip
+    # values plus the lock-stepped sentiment stage (a constant offset).
+    per_chip = [e["compute_seconds"] for e in metrics["per_chip"]]
+    assert len(per_chip) == 8
+    sentiment_seconds = metrics["stages"]["sentiment"]
+    for got, base in zip(per_chip, result.analysis.per_chip_compute):
+        assert got == pytest.approx(base + sentiment_seconds, abs=1e-6)
+
+
+def test_joint_reads_dataset_once(fixture_csv, tmp_path, monkeypatch):
+    """The sentiment stage must consume captured ingest records — never a
+    second DictReader pass over the file (BASELINE config[4] fusion)."""
+    from music_analyst_tpu.engines import sentiment as sentiment_mod
+
+    def _boom(*a, **k):
+        raise AssertionError("joint pipeline re-read the dataset")
+
+    monkeypatch.setattr(sentiment_mod, "iter_songs", _boom)
+    result = run_joint(
+        str(fixture_csv), output_dir=str(tmp_path), mock=True, quiet=True
+    )
+    assert sum(result.sentiment.counts.values()) == 7
+
+
+def test_joint_sentiment_rows_carry_song_titles(fixture_csv, tmp_path):
+    result = run_joint(
+        str(fixture_csv), output_dir=str(tmp_path), mock=True, quiet=True
+    )
+    by_song = {row.song: row.artist for row in result.sentiment.rows}
+    assert by_song["Ahe's My Kind Of Girl"] == "ABBA"
+    assert by_song["Unknown Song"] == ""  # empty-artist record still counted
 
 
 def test_joint_via_cli(fixture_csv, tmp_path, capsys):

@@ -104,7 +104,8 @@ def run_joint(
     ingest_seconds = timer.seconds["ingest"]
     # analysis.per_chip_compute already folds in the (shared) ingest time;
     # add only the sentiment stage on top.
-    per_chip = analysis.per_chip_compute or [0.0] * len(devices)
+    per_chip = analysis.per_chip_compute
+    assert len(per_chip) == len(devices), (len(per_chip), len(devices))
     per_chip_total = [c + sentiment_seconds for c in per_chip]
     write_performance_metrics(
         os.path.join(output_dir, "performance_metrics.json"),

@@ -258,6 +258,12 @@ def run_sentiment(
             finish(*in_flight)
         in_flight = pending
 
+    if songs is not None and resume:
+        # The resume skip count indexes the DictReader row order of a prior
+        # standalone run; a captured-records stream uses the exact parser,
+        # which counts malformed rows differently — mixing the two would
+        # silently misattribute rows.
+        raise ValueError("resume=True cannot be combined with songs=")
     source = (
         songs if songs is not None else iter_songs(dataset_path, limit=limit)
     )

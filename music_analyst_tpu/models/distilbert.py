@@ -232,9 +232,11 @@ class DistilBertClassifier(ClassifierBackend):
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            self.params = jax.device_put(
-                self.params, NamedSharding(mesh, P())
-            )
+            from music_analyst_tpu.parallel.sharding import shard_params
+
+            # Megatron-style TP rules; axes absent from the mesh prune to
+            # replication, so the same call serves dp-only and dp×tp.
+            self.params = shard_params(self.params, mesh)
             self._data_sharding = NamedSharding(mesh, P("dp"))
         else:
             self._data_sharding = None

@@ -29,6 +29,8 @@ _SUITE_MODULES = (
     "benchmarks.coldstart",
     "benchmarks.ingest",
     "benchmarks.scaling",
+    "benchmarks.joint",
+    "benchmarks.llama_zeroshot",
 )
 
 

@@ -1,0 +1,63 @@
+"""Shared helpers for the benchmark suites.
+
+Timing follows CLAUDE.md's environment rule: on the axon-tunneled TPU,
+``block_until_ready`` does not reliably wait, so every timed region ends
+with a forced readback (``np.asarray``) of (a slice of) the result.
+
+``smoke()`` is the test hook: with ``MUSICAAL_BENCH_SMOKE=1`` every suite
+shrinks to seconds-scale shapes so ``tests/test_benchmarks.py`` can keep
+the whole registry runnable on the CPU mesh without paying chip-scale
+compute.  Published numbers always come from full-size runs on hardware
+(``benchmarks/results/*.json`` records which).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Tuple
+
+import numpy as np
+
+
+def smoke() -> bool:
+    return os.environ.get("MUSICAAL_BENCH_SMOKE", "") not in ("", "0")
+
+
+def device_info() -> dict:
+    import jax
+
+    devices = jax.devices()
+    return {
+        "platform": devices[0].platform,
+        "n_devices": len(devices),
+        "device": str(devices[0]),
+    }
+
+
+def timed(fn: Callable[[], object], repeats: int = 3) -> Tuple[float, object]:
+    """Best-of-``repeats`` wall seconds for ``fn``, forced readback included.
+
+    ``fn`` must return a SMALL device array (reduce big results to a scalar
+    inside the jitted program) — it is fully read back inside the timed
+    region so async dispatch can't under-report, and a big result would
+    otherwise time the 17 MB/s tunnel instead of the chip.  Best-of rather
+    than mean: the quantity of interest is the program's steady-state cost,
+    and the minimum is the estimator least contaminated by one-off host
+    noise (same reasoning as timeit).
+    """
+    best = float("inf")
+    result = None
+    for _ in range(repeats):
+        start = time.perf_counter()
+        out = fn()
+        if hasattr(out, "shape"):
+            np.asarray(out)
+        elapsed = time.perf_counter() - start
+        if elapsed < best:
+            best, result = elapsed, out
+    return best, result
+
+
+def readback(x) -> np.ndarray:
+    return np.asarray(x)

@@ -1,0 +1,109 @@
+"""Flash-attention kernel vs dense XLA attention + block-size sweep.
+
+Backs the "Flash attention kernel" table in PERFORMANCE.md: bf16, B=4,
+H=8, D=64, causal.  Dense is the materialized ``[B,H,S,S]`` formulation
+(``models/layers.py:dot_product_attention``); flash is the Pallas blocked
+online-softmax kernel (``ops/flash_attention.py``).  The block sweep
+re-derives the kernel's default tile sizes instead of trusting them.
+"""
+
+from __future__ import annotations
+
+from benchmarks import suite
+from benchmarks._util import device_info, smoke, timed
+
+
+@suite("flash_sweep")
+def run() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from music_analyst_tpu.models.layers import (
+        causal_mask,
+        dot_product_attention,
+    )
+    from music_analyst_tpu.ops.flash_attention import flash_attention
+
+    B, H, D = (2, 2, 64) if smoke() else (4, 8, 64)
+    seqs = [256] if smoke() else [2048, 4096]
+    long_seq = 512 if smoke() else 16384
+    sweeps = [(128, 128)] if smoke() else [
+        (128, 128), (256, 256), (512, 512), (512, 1024), (1024, 1024),
+    ]
+
+    def qkv(S):
+        key = jax.random.key(0)
+        shape = (B, S, H, D)
+        return (
+            jax.random.normal(key, shape, jnp.bfloat16),
+            jax.random.normal(key, shape, jnp.bfloat16),
+            jax.random.normal(key, shape, jnp.bfloat16),
+        )
+
+    dense_fn = jax.jit(
+        lambda q, k, v, m: jnp.sum(
+            dot_product_attention(q, k, v, m).astype(jnp.float32)
+        )
+    )
+    flash_fn = jax.jit(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=True).astype(jnp.float32)
+        )
+    )
+
+    rows = []
+    for S in seqs:
+        q, k, v = qkv(S)
+        mask = causal_mask(S, S, 0)
+        dense_fn(q, k, v, mask)
+        dense_s, _ = timed(lambda: dense_fn(q, k, v, mask))
+        flash_fn(q, k, v)
+        flash_s, _ = timed(lambda: flash_fn(q, k, v))
+        rows.append(
+            {
+                "seq": S,
+                "dense_ms": round(dense_s * 1e3, 2),
+                "flash_ms": round(flash_s * 1e3, 2),
+                "speedup": round(dense_s / flash_s, 2),
+            }
+        )
+
+    # Long-context point: dense would be quadratic/OOM-bound; flash only.
+    q, k, v = qkv(long_seq)
+    flash_fn(q, k, v)
+    long_s, _ = timed(lambda: flash_fn(q, k, v))
+
+    sweep_rows = []
+    S = seqs[-1]
+    q, k, v = qkv(S)
+    for bq, bkv in sweeps:
+        if bq > S or bkv > S:
+            continue
+        fn = jax.jit(
+            lambda q, k, v, bq=bq, bkv=bkv: jnp.sum(
+                flash_attention(
+                    q, k, v, causal=True, block_q=bq, block_kv=bkv
+                ).astype(jnp.float32)
+            )
+        )
+        try:
+            fn(q, k, v)
+            s, _ = timed(lambda: fn(q, k, v))
+            sweep_rows.append(
+                {"block_q": bq, "block_kv": bkv, "ms": round(s * 1e3, 2)}
+            )
+        except Exception as exc:  # VMEM OOM at big tiles is itself a result
+            sweep_rows.append(
+                {"block_q": bq, "block_kv": bkv, "error": str(exc)[:120]}
+            )
+
+    return {
+        "suite": "flash_sweep",
+        **device_info(),
+        "smoke": smoke(),
+        "shape": f"B={B} H={H} D={D} bf16 causal",
+        "dense_vs_flash": rows,
+        "flash_long_context": {"seq": long_seq, "ms": round(long_s * 1e3, 2)},
+        "block_sweep_at_seq": S,
+        "block_sweep": sweep_rows,
+    }

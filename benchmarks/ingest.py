@@ -1,0 +1,76 @@
+"""Native ingest throughput: parse + tokenize + intern, MB/s and songs/s.
+
+Backs the "Native ingest" section in PERFORMANCE.md.  Generates a
+synthetic corpus (same generator the tests use), ingests it with the
+multithreaded C++ scanner (``native/ingest.cpp``) and with the pure-Python
+oracle on a subset, and reports both — the ratio is what the native layer
+buys the host side of every analysis run.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+
+from benchmarks import suite
+from benchmarks._util import smoke
+
+
+@suite("ingest")
+def run() -> dict:
+    from music_analyst_tpu.data import native
+    from music_analyst_tpu.data.ingest import ingest_python
+    from music_analyst_tpu.data.synthetic import generate_dataset
+
+    n_songs = 2_000 if smoke() else 100_000
+    oracle_songs = 500 if smoke() else 5_000
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "songs.csv")
+        generate_dataset(path, num_songs=n_songs, seed=11)
+        size_mb = os.path.getsize(path) / (1 << 20)
+
+        native_available = native.available()
+        if native_available:
+            native.ingest_native(path)  # warm page cache / lib load
+            start = time.perf_counter()
+            res = native.ingest_native(path)
+            native_s = time.perf_counter() - start
+            native_row = {
+                "seconds": round(native_s, 3),
+                "mb_per_s": round(size_mb / native_s, 1),
+                "songs_per_s": round(res.song_count / native_s, 1),
+                "tokens": res.token_count,
+            }
+            # capture_records (the fused joint pipeline's mode) on top:
+            start = time.perf_counter()
+            native.ingest_native(path, capture_records=True)
+            capture_s = time.perf_counter() - start
+            native_row["capture_records_seconds"] = round(capture_s, 3)
+        else:
+            native_row = {"error": native.unavailable_reason()}
+
+        with open(path, "rb") as fh:
+            data = fh.read()
+        start = time.perf_counter()
+        ingest_python(data, limit=oracle_songs)
+        python_s = time.perf_counter() - start
+        python_songs_per_s = oracle_songs / python_s
+
+    out = {
+        "suite": "ingest",
+        "smoke": smoke(),
+        "corpus": {"songs": n_songs, "mb": round(size_mb, 1)},
+        "native": native_row,
+        "python_oracle": {
+            "songs": oracle_songs,
+            "seconds": round(python_s, 3),
+            "songs_per_s": round(python_songs_per_s, 1),
+        },
+    }
+    if native_available and "songs_per_s" in native_row:
+        out["native_over_python"] = round(
+            native_row["songs_per_s"] / python_songs_per_s, 1
+        )
+    return out

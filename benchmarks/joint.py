@@ -1,0 +1,56 @@
+"""Joint pipeline throughput: fused ingest + histogram + mock sentiment.
+
+Backs the "Joint pipeline" section in PERFORMANCE.md and BASELINE
+config[4].  One ``run_joint`` call over a synthetic 100k-song corpus on
+the current backend: the single capture-records ingest feeds both the
+sharded histogram and the vectorized keyword-sentiment kernel, and the
+suite reports end-to-end songs/s plus the stage breakdown the metrics
+file records.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+
+from benchmarks import suite
+from benchmarks._util import device_info, smoke
+
+
+@suite("joint")
+def run() -> dict:
+    from music_analyst_tpu.data.synthetic import generate_dataset
+    from music_analyst_tpu.engines.joint import run_joint
+
+    n_songs = 2_000 if smoke() else 100_000
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "songs.csv")
+        generate_dataset(path, num_songs=n_songs, seed=11)
+        size_mb = os.path.getsize(path) / (1 << 20)
+        out_dir = os.path.join(tmp, "out")
+
+        # Warm run compiles the kernels (persistent cache makes this cheap
+        # across processes); the measured run is steady-state.
+        run_joint(path, output_dir=out_dir, mock=True, quiet=True,
+                  limit=min(n_songs, 512))
+        start = time.perf_counter()
+        result = run_joint(path, output_dir=out_dir, mock=True, quiet=True)
+        wall = time.perf_counter() - start
+
+    return {
+        "suite": "joint",
+        **device_info(),
+        "smoke": smoke(),
+        "corpus": {"songs": n_songs, "mb": round(size_mb, 1)},
+        "seconds": round(wall, 2),
+        "songs_per_s": round(result.analysis.total_songs / wall, 1),
+        "consistent_song_count": (
+            sum(result.sentiment.counts.values())
+            == result.analysis.total_songs
+        ),
+        "stages": {
+            k: round(v, 3) for k, v in result.analysis.timings.items()
+        },
+    }

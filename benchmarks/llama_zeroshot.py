@@ -1,0 +1,81 @@
+"""Llama zero-shot label-scoring throughput (BASELINE config[3]).
+
+The reference's config[3] classifies one song per blocking Ollama HTTP
+round-trip (~1 song/s wall, ``scripts/sentiment_classifier.py:85-100``);
+the replacement scores the three label continuations in one batched
+on-device program (``models/llama.py:_score_labels``).  This suite
+measures that path at a realistic batch size.
+
+Model size: defaults to a ~1.1B-parameter decoder (llama-3 topology,
+scaled dims) so the measurement is architecture-honest while fitting
+comfortably beside the benchmark batch in one v5e chip's HBM; set
+``MUSICAAL_BENCH_LLAMA=llama3-8b`` to run the full 8B architecture
+(random weights either way — zero-egress environment; throughput is
+weight-value-independent).
+"""
+
+from __future__ import annotations
+
+import os
+
+from benchmarks import suite
+from benchmarks._util import device_info, smoke, timed
+
+
+def _bench_config():
+    from music_analyst_tpu.models.llama import PRESETS, LlamaConfig
+
+    preset = os.environ.get("MUSICAAL_BENCH_LLAMA", "")
+    if preset:
+        return preset, PRESETS[preset]()
+    # ~1.1B params: llama-3 topology at half width/depth.
+    return "llama3-1b-proxy", LlamaConfig(
+        vocab_size=128_256, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+        hidden_dim=8192, rope_theta=500_000.0, max_seq_len=8192,
+    )
+
+
+@suite("llama_zeroshot")
+def run() -> dict:
+    import jax
+    import numpy as np
+
+    from music_analyst_tpu.models.llama import (
+        LlamaConfig,
+        LlamaZeroShotClassifier,
+    )
+
+    if smoke():
+        name, cfg = "tiny", LlamaConfig.tiny()
+        batch, max_prompt = 16, 64
+    else:
+        name, cfg = _bench_config()
+        batch, max_prompt = 256, 256
+
+    clf = LlamaZeroShotClassifier(
+        config=cfg, max_prompt_len=max_prompt, seed=0
+    )
+    n_params = sum(
+        int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(clf.params)
+    )
+    texts = [
+        f"lyric {i}: love and rain fall over the lonely city tonight "
+        * (1 + i % 3)
+        for i in range(batch)
+    ]
+    clf.classify_batch(texts)  # compile + first dispatch
+    seconds, _ = timed(lambda: clf.classify_batch(texts) or 0, repeats=2)
+    songs_per_s = batch / seconds
+
+    return {
+        "suite": "llama_zeroshot",
+        **device_info(),
+        "smoke": smoke(),
+        "model": name,
+        "params_m": round(n_params / 1e6, 1),
+        "batch": batch,
+        "max_prompt_len": max_prompt,
+        "seconds": round(seconds, 3),
+        "songs_per_s": round(songs_per_s, 1),
+        "reference_wall": "~1 song/s (per-song blocking Ollama HTTP loop)",
+    }

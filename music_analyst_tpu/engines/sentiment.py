@@ -174,6 +174,13 @@ def run_sentiment(
     records its single ingest captured, so the file is opened once per run
     (``limit`` is ignored then; the producer already applied it).
     """
+    if songs is not None and resume:
+        # The resume skip count indexes the DictReader row order of a prior
+        # standalone run; a captured-records stream uses the exact parser,
+        # which counts malformed rows differently — mixing the two would
+        # silently misattribute rows.  Checked before any output file is
+        # touched.
+        raise ValueError("resume=True cannot be combined with songs=")
     os.makedirs(output_dir, exist_ok=True)
     if backend is None:
         # Every built-in backend compiles device programs (the mock path
@@ -258,12 +265,6 @@ def run_sentiment(
             finish(*in_flight)
         in_flight = pending
 
-    if songs is not None and resume:
-        # The resume skip count indexes the DictReader row order of a prior
-        # standalone run; a captured-records stream uses the exact parser,
-        # which counts malformed rows differently — mixing the two would
-        # silently misattribute rows.
-        raise ValueError("resume=True cannot be combined with songs=")
     source = (
         songs if songs is not None else iter_songs(dataset_path, limit=limit)
     )

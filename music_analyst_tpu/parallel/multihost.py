@@ -11,7 +11,7 @@ present, so engine code calls them unconditionally.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import numpy as np
@@ -19,6 +19,10 @@ import numpy as np
 
 def process_count() -> int:
     return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
 
 
 def is_coordinator() -> bool:
@@ -52,3 +56,51 @@ def all_agree(value) -> bool:
 
     gathered = multihost_utils.process_allgather(np.asarray(value))
     return bool((gathered == gathered[0]).all())
+
+
+def allgather_bytes(payload: bytes) -> list:
+    """Gather one byte string from every process, in process order.
+
+    The building block for metadata exchange (vocabulary merge) that the
+    reference does with serialized ``MPI_Send``/``MPI_Recv`` strings
+    (``src/parallel_spotify.c:396-432``).  Collectives need uniform shapes,
+    so this is two rounds: an allgather of lengths, then an allgather of
+    max-length-padded ``uint8`` rows.
+    """
+    if jax.process_count() == 1:
+        return [payload]
+    from jax.experimental import multihost_utils
+
+    lengths = multihost_utils.process_allgather(
+        np.asarray([len(payload)], dtype=np.int64)
+    ).ravel()
+    width = max(1, int(lengths.max()))
+    row = np.zeros((width,), dtype=np.uint8)
+    row[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    rows = multihost_utils.process_allgather(row)
+    return [
+        rows[i, : int(lengths[i])].tobytes()
+        for i in range(jax.process_count())
+    ]
+
+
+def broadcast_bytes(payload: Optional[bytes]) -> bytes:
+    """Broadcast a byte string from the coordinator to every process.
+
+    The analogue of the reference's ``MPI_Bcast`` of the split-file names
+    (``src/parallel_spotify.c:830-831``), for variable-size payloads:
+    length first, then the padded byte row, both via
+    :func:`broadcast_from_coordinator`.
+    """
+    if jax.process_count() == 1:
+        assert payload is not None
+        return payload
+    data = payload if is_coordinator() else b""
+    length = int(
+        broadcast_from_coordinator(np.asarray([len(data)], np.int64))[0]
+    )
+    row = np.zeros((max(1, length),), dtype=np.uint8)
+    if is_coordinator():
+        row[:length] = np.frombuffer(data, dtype=np.uint8)
+    row = broadcast_from_coordinator(row)
+    return row[:length].tobytes()

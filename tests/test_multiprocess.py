@@ -1,0 +1,106 @@
+"""REAL multi-process execution: two JAX processes, Gloo CPU collectives.
+
+The reference verifies its parallelism by actually running N ranks
+(``mpirun -np N``, src/parallel_spotify.c:725-730); the JAX-native
+equivalent is two OS processes under ``jax.distributed.initialize`` with
+4 virtual CPU devices each (8 global).  Each child ingests a disjoint
+record range, merges vocabularies through the coordinator, psums dense
+histograms across all 8 devices, and the coordinator's word_counts.csv
+must be byte-identical to a single-process run of the same corpus.
+
+These children must NOT inherit the conftest's in-process jax setup —
+they configure their own platform via env before importing jax.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+_CHILD = r"""
+import os, sys
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+dataset = sys.argv[3]
+out_dir = sys.argv[4]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.distributed.initialize(
+    coordinator_address=f"localhost:{port}", num_processes=2,
+    process_id=proc_id,
+)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+from music_analyst_tpu.parallel.distributed import distributed_wordcount
+result = distributed_wordcount(dataset, output_dir=out_dir)
+print(f"RESULT {result['total_songs']} {result['total_words']}")
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_wordcount_matches_single_process(tmp_path):
+    from music_analyst_tpu.data.csv_io import write_count_csv, sort_count_entries
+    from music_analyst_tpu.data.ingest import ingest_python
+    from music_analyst_tpu.data.synthetic import generate_dataset
+
+    dataset = tmp_path / "songs.csv"
+    generate_dataset(str(dataset), num_songs=300, seed=21)
+    out_dir = tmp_path / "dist_out"
+
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="")
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    port = str(_free_port())
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _CHILD, str(p), port, str(dataset),
+             str(out_dir)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=repo,
+        )
+        for p in (0, 1)
+    ]
+    outs = []
+    try:
+        for proc in procs:
+            out, err = proc.communicate(timeout=240)
+            assert proc.returncode == 0, f"child failed:\n{err[-1500:]}"
+            outs.append(out)
+    finally:
+        # A failed/timed-out child must not leave its peer blocked in
+        # jax.distributed.initialize holding the coordinator port.
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+
+    # Both processes report identical global totals.
+    results = [
+        line for out in outs for line in out.splitlines()
+        if line.startswith("RESULT ")
+    ]
+    assert len(results) == 2 and results[0] == results[1], results
+
+    # Coordinator's export is byte-identical to the single-process oracle.
+    import numpy as np
+
+    corpus = ingest_python(dataset.read_bytes())
+    counts = np.bincount(
+        corpus.word_ids[corpus.word_ids >= 0],
+        minlength=len(corpus.word_vocab),
+    )
+    expect_path = tmp_path / "expect_word_counts.csv"
+    write_count_csv(
+        str(expect_path), "word",
+        sort_count_entries(corpus.word_vocab.counts_to_entries(counts)),
+    )
+    got = (out_dir / "word_counts.csv").read_bytes()
+    assert got == expect_path.read_bytes()
+    total_songs = int(results[0].split()[1])
+    assert total_songs == corpus.song_count

@@ -10,6 +10,11 @@ process.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Hermetic for subprocess-spawning tests too (benchmark suite children,
+# multiprocess children): with the axon pool var cleared, the children's
+# sitecustomize never registers the TPU plugin, so a wedged/dead tunnel
+# cannot hang the CPU-only test suite.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

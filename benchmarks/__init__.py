@@ -31,6 +31,7 @@ _SUITE_MODULES = (
     "benchmarks.scaling",
     "benchmarks.joint",
     "benchmarks.llama_zeroshot",
+    "benchmarks.sentiment_int8",
 )
 
 

@@ -41,7 +41,8 @@ def timed(fn: Callable[[], object], repeats: int = 3) -> Tuple[float, object]:
     ``fn`` must return a SMALL device array (reduce big results to a scalar
     inside the jitted program) — it is fully read back inside the timed
     region so async dispatch can't under-report, and a big result would
-    otherwise time the 17 MB/s tunnel instead of the chip.  Best-of rather
+    otherwise time the ~10 MB/s tunnel (roofline suite's measured
+    host→device figure) instead of the chip.  Best-of rather
     than mean: the quantity of interest is the program's steady-state cost,
     and the minimum is the estimator least contaminated by one-off host
     noise (same reasoning as timeit).

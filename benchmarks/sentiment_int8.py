@@ -1,0 +1,67 @@
+"""bf16 vs dynamic-int8 DistilBERT classify throughput (headline shapes).
+
+The roofline suite measures the v5e MXU int8 path at ~2.1× bf16; the
+headline bf16 forward already runs near its roofline, so int8 is the
+remaining big FLOP lever.  This suite runs the SAME classifier batch
+through ``distilbert`` and ``distilbert-int8`` (identical params — the
+quant modules share the float param tree) and reports both throughputs
+plus the label agreement between the two paths, which is the accuracy
+cost being bought.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from benchmarks import suite
+from benchmarks._util import device_info, smoke, timed
+
+
+@suite("sentiment_int8")
+def run() -> dict:
+    from music_analyst_tpu.models.distilbert import (
+        DistilBertClassifier,
+        DistilBertConfig,
+    )
+
+    if smoke():
+        cfg, batch, max_len = DistilBertConfig.tiny(), 64, 64
+    else:
+        cfg, batch, max_len = DistilBertConfig(), 8192, 128
+
+    texts = [
+        f"song {i}: love and rain over the lonely city " * (1 + i % 4)
+        for i in range(batch)
+    ]
+    bf16 = DistilBertClassifier(config=cfg, max_len=max_len, seed=0)
+    int8 = DistilBertClassifier(
+        config=dataclasses.replace(cfg, quant="int8"), max_len=max_len,
+        seed=0,
+    )
+    # Same params through both paths: the comparison isolates the matmul
+    # kernel, and the agreement number is meaningful.
+    int8.params = bf16.params
+
+    bf16_labels = bf16.classify_batch(texts)  # compile + dispatch
+    bf16_s, _ = timed(lambda: bf16.classify_batch(texts) or 0, repeats=2)
+    int8_labels = int8.classify_batch(texts)
+    int8_s, _ = timed(lambda: int8.classify_batch(texts) or 0, repeats=2)
+
+    agree = sum(a == b for a, b in zip(bf16_labels, int8_labels)) / batch
+    return {
+        "suite": "sentiment_int8",
+        **device_info(),
+        "smoke": smoke(),
+        "model": "tiny" if smoke() else "DistilBERT full-size",
+        "batch": batch,
+        "max_len": max_len,
+        "bf16_songs_per_s": round(batch / bf16_s, 1),
+        "int8_songs_per_s": round(batch / int8_s, 1),
+        "speedup": round(bf16_s / int8_s, 2),
+        "label_agreement": round(agree, 4),
+        "note": (
+            "random weights — agreement reflects quant noise near the "
+            "decision threshold, not task accuracy; re-run with "
+            "MUSICAAL_DISTILBERT_CKPT for calibrated labels"
+        ),
+    }

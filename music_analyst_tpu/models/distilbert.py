@@ -47,6 +47,10 @@ class DistilBertConfig:
     # "flash" = Pallas blocked attention (padding-mask path); max_len must
     # divide the kernel block size.
     attn_impl: str = "dense"
+    # "int8" = dynamic-quant projections/MLP on the MXU int8 path
+    # (ops/quant.py; ~2.1x bf16 matmul throughput per the roofline suite).
+    # Inference-only; small logit perturbation bounded by tests/test_quant.py.
+    quant: str = "none"
 
     @classmethod
     def tiny(cls) -> "DistilBertConfig":
@@ -66,11 +70,13 @@ class TransformerBlock(nn.Module):
         attn_out = MultiHeadAttention(
             n_heads=cfg.n_heads, dtype=dtype, attn_impl=cfg.attn_impl,
             use_bias=True,  # HF DistilBERT q/k/v/out projections have biases
+            quant=cfg.quant,
             name="attention",
         )(x, mask=None if cfg.attn_impl == "flash" else mask,
           lengths=lengths)
         x = nn.LayerNorm(name="sa_layer_norm", dtype=dtype)(x + attn_out)
-        mlp_out = GeluMLP(cfg.hidden_dim, dtype=dtype, name="ffn")(x)
+        mlp_out = GeluMLP(cfg.hidden_dim, dtype=dtype, quant=cfg.quant,
+                          name="ffn")(x)
         return nn.LayerNorm(name="output_layer_norm", dtype=dtype)(x + mlp_out)
 
 
@@ -262,8 +268,15 @@ class DistilBertClassifier(ClassifierBackend):
             "MUSICAAL_DISTILBERT_CKPT"
         )
         config = kwargs.pop("config", None)
+        quant = "none"
+        if model.endswith("-int8"):
+            model, quant = model[: -len("-int8")], "int8"
         if model.endswith("-tiny"):
             config = config or DistilBertConfig.tiny()
+        if quant != "none":
+            config = dataclasses.replace(
+                config or DistilBertConfig(), quant=quant
+            )
         return cls(config=config, checkpoint_path=ckpt, **kwargs)
 
     def _pad_batch(self, batch: np.ndarray, lengths: np.ndarray):

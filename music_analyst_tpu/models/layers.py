@@ -9,7 +9,7 @@ are kept divisible by the tp degree by construction in the model configs).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -131,6 +131,51 @@ def dot_product_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+class QuantDenseGeneral(nn.Module):
+    """Drop-in for the two ``nn.DenseGeneral`` layouts with int8 compute.
+
+    Parameter names/shapes are IDENTICAL to ``nn.DenseGeneral`` (`kernel`,
+    `bias`), so checkpoint loaders, TP sharding rules, and params trained
+    or initialized by the float modules apply unchanged — only the matmul
+    runs through the dynamic int8 path (``ops/quant.py``).
+    """
+
+    features: Any          # int or tuple, as nn.DenseGeneral
+    axis: Any = -1         # -1 or (-2, -1)
+    use_bias: bool = True
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from music_analyst_tpu.ops.quant import (
+            quant_dense_axis_last,
+            quant_dense_axis_last2,
+        )
+
+        feat = (
+            (self.features,)
+            if isinstance(self.features, int)
+            else tuple(self.features)
+        )
+        if self.axis == -1:
+            kshape = (x.shape[-1],) + feat
+        elif not isinstance(self.axis, int) and tuple(self.axis) == (-2, -1):
+            assert len(feat) == 1
+            kshape = (x.shape[-2], x.shape[-1], feat[0])
+        else:
+            raise ValueError(f"unsupported axis {self.axis!r}")
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), kshape, jnp.float32
+        )
+        bias = (
+            self.param("bias", nn.initializers.zeros, feat, jnp.float32)
+            if self.use_bias
+            else None
+        )
+        fn = quant_dense_axis_last if self.axis == -1 else quant_dense_axis_last2
+        return fn(x, kernel, bias, out_dtype=self.dtype)
+
+
 class MultiHeadAttention(nn.Module):
     """MHA/GQA with optional RoPE and optional KV cache.
 
@@ -155,6 +200,9 @@ class MultiHeadAttention(nn.Module):
     # BERT-family projections carry biases (HF q_lin/k_lin/v_lin/out_lin
     # each have one); Llama-family does not.
     use_bias: bool = False
+    # "int8" routes the Q/K/V/O projections through the dynamic int8
+    # matmul (ops/quant.py) — inference-only MXU throughput lever.
+    quant: str = "none"
 
     @nn.compact
     def __call__(
@@ -168,7 +216,10 @@ class MultiHeadAttention(nn.Module):
         features = x.shape[-1]
         n_kv = self.n_kv_heads or self.n_heads
         head_dim = self.head_dim or features // self.n_heads
-        dense = lambda feats, name: nn.DenseGeneral(  # noqa: E731
+        dense_cls = (
+            QuantDenseGeneral if self.quant == "int8" else nn.DenseGeneral
+        )
+        dense = lambda feats, name: dense_cls(  # noqa: E731
             features=feats,
             axis=-1,
             use_bias=self.use_bias,
@@ -214,7 +265,7 @@ class MultiHeadAttention(nn.Module):
             )
         else:
             out = dot_product_attention(q, k, v, mask)
-        out = nn.DenseGeneral(
+        out = dense_cls(
             features=features,
             axis=(-2, -1),
             use_bias=self.use_bias,
@@ -248,13 +299,22 @@ class GeluMLP(nn.Module):
 
     hidden_dim: int
     dtype: jnp.dtype = jnp.bfloat16
+    quant: str = "none"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         features = x.shape[-1]
-        h = nn.Dense(self.hidden_dim, dtype=self.dtype, name="lin1")(x)
+        if self.quant == "int8":
+            dense = lambda feats, name: QuantDenseGeneral(  # noqa: E731
+                features=feats, dtype=self.dtype, name=name
+            )
+        else:
+            dense = lambda feats, name: nn.Dense(  # noqa: E731
+                feats, dtype=self.dtype, name=name
+            )
+        h = dense(self.hidden_dim, "lin1")(x)
         h = nn.gelu(h, approximate=False)
-        return nn.Dense(features, dtype=self.dtype, name="lin2")(h)
+        return dense(features, "lin2")(h)
 
 
 def causal_mask(q_len: int, kv_len: int, offset) -> jax.Array:

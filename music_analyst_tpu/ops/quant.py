@@ -1,0 +1,70 @@
+"""Dynamic int8 quantized matmul for inference (w8a8, int32 accumulate).
+
+The roofline suite measures the v5e MXU at ~2.1× bf16 throughput for
+int8×int8→int32 chains (``benchmarks/results/roofline.json``), and the
+headline DistilBERT forward already runs at ~93% of the bf16 roofline —
+so int8 is the remaining large FLOP lever.  This op quantizes on the fly:
+
+* weights: symmetric per-output-channel, ``s_w[c] = max|w[:,c]| / 127`` —
+  computed inside the jitted forward from the ordinary float params, so
+  the param tree, checkpoint loaders, and sharding rules are untouched;
+* activations: symmetric per-tensor dynamic, ``s_x = max|x| / 127`` per
+  call (one cheap reduction);
+* accumulation in int32 on the MXU, dequant ``acc · s_x · s_w[c]`` fused
+  into the epilogue by XLA.
+
+Accuracy contract: quantization error is bounded by the symmetric-int8
+resolution (~0.8% of the dynamic range per operand); the classifier's
+2→3-label thresholding absorbs small logit shifts, and
+``tests/test_quant.py`` pins both the op-level error and end-to-end label
+agreement.  No reference analogue (the reference's model lives behind an
+HTTP API); this is a TPU-hardware play, default OFF (``quant="none"``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _symmetric_scale(value: jax.Array, axis, keepdims: bool = True):
+    amax = jnp.max(jnp.abs(value), axis=axis, keepdims=keepdims)
+    return jnp.maximum(amax, 1e-8) / 127.0
+
+
+def quant_matmul(x: jax.Array, w: jax.Array) -> jax.Array:
+    """``x @ w`` via dynamic int8: x ``[..., K]`` f32/bf16, w ``[K, N]``.
+
+    Returns f32 ``[..., N]``.
+    """
+    x32 = x.astype(jnp.float32)
+    w32 = w.astype(jnp.float32)
+    s_x = _symmetric_scale(x32, axis=None, keepdims=False)
+    s_w = _symmetric_scale(w32, axis=0)  # [1, N]
+    qx = jnp.round(x32 / s_x).astype(jnp.int8)
+    qw = jnp.round(w32 / s_w).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        qx, qw,
+        (((qx.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return acc.astype(jnp.float32) * (s_x * s_w.reshape(1, -1))
+
+
+def quant_dense_axis_last(x, kernel, bias=None, out_dtype=None):
+    """DenseGeneral(axis=-1): x ``[..., K]``, kernel ``[K, *F]`` → ``[..., *F]``."""
+    feat_shape = kernel.shape[1:]
+    out = quant_matmul(x, kernel.reshape(kernel.shape[0], -1))
+    out = out.reshape(x.shape[:-1] + feat_shape)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(out_dtype or x.dtype)
+
+
+def quant_dense_axis_last2(x, kernel, bias=None, out_dtype=None):
+    """DenseGeneral(axis=(-2,-1)): x ``[..., H, D]``, kernel ``[H, D, N]``."""
+    H, D, N = kernel.shape
+    out = quant_matmul(x.reshape(x.shape[:-2] + (H * D,)), kernel.reshape(H * D, N))
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(out_dtype or x.dtype)

@@ -1,0 +1,88 @@
+"""Dynamic int8 matmul path: op-level error bounds + model parity.
+
+The quant modules share the float param tree with the dense modules, so
+the parity tests initialize ONE set of params with the dense model and
+apply both models to the same inputs — any structural drift between the
+trees fails loudly at apply time.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from music_analyst_tpu.ops.quant import (
+    quant_dense_axis_last,
+    quant_dense_axis_last2,
+    quant_matmul,
+)
+
+
+def test_quant_matmul_error_bound():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 256)).astype(np.float32)
+    w = rng.normal(size=(256, 128)).astype(np.float32)
+    exact = x @ w
+    got = np.asarray(quant_matmul(jnp.asarray(x), jnp.asarray(w)))
+    rel = np.linalg.norm(got - exact) / np.linalg.norm(exact)
+    assert rel < 0.02, rel  # symmetric int8: ~0.8% per operand
+
+
+def test_quant_dense_layouts_match_dense_math():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(4, 10, 32)), jnp.float32)
+    # axis=-1 with multi-dim features [dim, heads, head_dim]
+    k = jnp.asarray(rng.normal(size=(32, 4, 8)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
+    got = np.asarray(quant_dense_axis_last(x, k, b))
+    exact = np.einsum("btk,khd->bthd", x, k) + np.asarray(b)
+    assert got.shape == exact.shape
+    rel = np.linalg.norm(got - exact) / np.linalg.norm(exact)
+    assert rel < 0.03, rel
+    # axis=(-2,-1): [B, T, H, D] @ [H, D, N]
+    xo = jnp.asarray(rng.normal(size=(4, 10, 4, 8)), jnp.float32)
+    ko = jnp.asarray(rng.normal(size=(4, 8, 32)), jnp.float32)
+    bo = jnp.asarray(rng.normal(size=(32,)), jnp.float32)
+    got2 = np.asarray(quant_dense_axis_last2(xo, ko, bo))
+    exact2 = np.einsum("bthd,hdn->btn", xo, ko) + np.asarray(bo)
+    assert got2.shape == exact2.shape
+    rel2 = np.linalg.norm(got2 - exact2) / np.linalg.norm(exact2)
+    assert rel2 < 0.03, rel2
+
+
+def test_int8_model_logits_track_dense_model():
+    """Same params through the fp32 and int8 DistilBERT forwards: logits
+    must correlate tightly — quantization noise, not structural change."""
+    from music_analyst_tpu.models.distilbert import (
+        DistilBertConfig,
+        DistilBertForSentiment,
+    )
+
+    cfg = dataclasses.replace(DistilBertConfig.tiny(), dtype="float32")
+    qcfg = dataclasses.replace(cfg, quant="int8")
+    model = DistilBertForSentiment(cfg)
+    qmodel = DistilBertForSentiment(qcfg)
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 32)), jnp.int32)
+    lengths = jnp.asarray(rng.integers(4, 33, (8,)), jnp.int32)
+    params = model.init(jax.random.key(0), ids, lengths)["params"]
+    dense_logits = np.asarray(model.apply({"params": params}, ids, lengths))
+    quant_logits = np.asarray(qmodel.apply({"params": params}, ids, lengths))
+    assert dense_logits.shape == quant_logits.shape
+    corr = np.corrcoef(dense_logits.ravel(), quant_logits.ravel())[0, 1]
+    assert corr > 0.99, corr
+    spread = dense_logits.max() - dense_logits.min()
+    assert np.abs(quant_logits - dense_logits).max() < 0.1 * spread
+
+
+def test_int8_classifier_end_to_end():
+    from music_analyst_tpu.models.distilbert import DistilBertClassifier
+
+    clf = DistilBertClassifier.from_pretrained_or_random(
+        "distilbert-tiny-int8", max_len=64
+    )
+    assert clf.config.quant == "int8"
+    labels = clf.classify_batch(["love and rain", "", "tears " * 30])
+    assert labels[1] == "Neutral"
+    assert all(l in ("Positive", "Neutral", "Negative") for l in labels)

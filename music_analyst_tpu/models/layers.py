@@ -159,14 +159,30 @@ class QuantDenseGeneral(nn.Module):
         )
         if self.axis == -1:
             kshape = (x.shape[-1],) + feat
+            n_contract = 1
         elif not isinstance(self.axis, int) and tuple(self.axis) == (-2, -1):
             assert len(feat) == 1
             kshape = (x.shape[-2], x.shape[-1], feat[0])
+            n_contract = 2
         else:
             raise ValueError(f"unsupported axis {self.axis!r}")
-        kernel = self.param(
-            "kernel", nn.initializers.lecun_normal(), kshape, jnp.float32
-        )
+
+        def kernel_init(key, shape, dtype):
+            # Match nn.DenseGeneral: initialize on the FLATTENED 2-D shape
+            # (fan_in = prod of contracted axes) and reshape — raw
+            # lecun_normal on a 3-D shape would treat the leading dim as a
+            # conv receptive field and under-scale by sqrt(n_heads).
+            import numpy as _np
+
+            flat = (
+                int(_np.prod(shape[:n_contract])),
+                int(_np.prod(shape[n_contract:])),
+            )
+            return nn.initializers.lecun_normal()(key, flat, dtype).reshape(
+                shape
+            )
+
+        kernel = self.param("kernel", kernel_init, kshape, jnp.float32)
         bias = (
             self.param("bias", nn.initializers.zeros, feat, jnp.float32)
             if self.use_bias
@@ -282,16 +298,22 @@ class SwiGLU(nn.Module):
 
     hidden_dim: int
     dtype: jnp.dtype = jnp.bfloat16
+    quant: str = "none"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         features = x.shape[-1]
-        gate = nn.Dense(self.hidden_dim, use_bias=False, dtype=self.dtype,
-                        name="gate_proj")(x)
-        up = nn.Dense(self.hidden_dim, use_bias=False, dtype=self.dtype,
-                      name="up_proj")(x)
-        return nn.Dense(features, use_bias=False, dtype=self.dtype,
-                        name="down_proj")(nn.silu(gate) * up)
+        if self.quant == "int8":
+            dense = lambda feats, name: QuantDenseGeneral(  # noqa: E731
+                features=feats, use_bias=False, dtype=self.dtype, name=name
+            )
+        else:
+            dense = lambda feats, name: nn.Dense(  # noqa: E731
+                feats, use_bias=False, dtype=self.dtype, name=name
+            )
+        gate = dense(self.hidden_dim, "gate_proj")(x)
+        up = dense(self.hidden_dim, "up_proj")(x)
+        return dense(features, "down_proj")(nn.silu(gate) * up)
 
 
 class GeluMLP(nn.Module):

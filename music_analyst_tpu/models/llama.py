@@ -72,6 +72,9 @@ class LlamaConfig:
     # "flash" uses the Pallas blocked-attention kernel on the no-cache
     # (prefill/training) path; seq len must divide its block size.
     attn_impl: str = "dense"
+    # "int8" routes attention/MLP projections through the dynamic int8
+    # matmul (ops/quant.py) — inference-only; see DistilBertConfig.quant.
+    quant: str = "none"
 
     @classmethod
     def llama3_8b(cls) -> "LlamaConfig":
@@ -112,6 +115,7 @@ class LlamaBlock(nn.Module):
             dtype=dtype,
             attn_impl=cfg.attn_impl,
             flash_causal=True,
+            quant=cfg.quant,
             name="attention",
         )
         h = RMSNorm(name="attention_norm")(x)
@@ -134,12 +138,21 @@ class LlamaBlock(nn.Module):
         if cfg.n_experts > 0:
             from music_analyst_tpu.models.moe import MoESwiGLU
 
+            if cfg.quant != "none":
+                # Refuse rather than silently quantize only the attention
+                # projections: the expert MLPs are the bulk of MoE FLOPs,
+                # and a mostly-bf16 model labeled "int8" would mislead
+                # every benchmark comparison.
+                raise NotImplementedError(
+                    "quant='int8' is not supported for MoE configs yet"
+                )
             ffn = MoESwiGLU(
                 cfg.n_experts, cfg.hidden_dim, top_k=cfg.moe_top_k,
                 dtype=dtype, name="feed_forward_moe",
             )
         else:
-            ffn = SwiGLU(cfg.hidden_dim, dtype=dtype, name="feed_forward")
+            ffn = SwiGLU(cfg.hidden_dim, dtype=dtype, quant=cfg.quant,
+                         name="feed_forward")
         x = x + ffn(h)
         return x, new_cache
 
@@ -521,12 +534,17 @@ class LlamaZeroShotClassifier(ClassifierBackend):
 
     @classmethod
     def from_pretrained_or_random(cls, model: str, **kwargs):
+        quant = "none"
+        if model.endswith("-int8"):
+            model, quant = model[: -len("-int8")], "int8"
         preset = PRESETS.get(model)
         if preset is None:
             raise ValueError(
                 f"unknown llama preset {model!r}; options: {sorted(PRESETS)}"
             )
         config = kwargs.pop("config", None) or preset()
+        if quant != "none":
+            config = dataclasses.replace(config, quant=quant)
         ckpt = kwargs.pop("checkpoint_path", None) or os.environ.get(
             "MUSICAAL_LLAMA_CKPT"
         )

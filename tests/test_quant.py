@@ -76,6 +76,75 @@ def test_int8_model_logits_track_dense_model():
     assert np.abs(quant_logits - dense_logits).max() < 0.1 * spread
 
 
+def test_int8_llama_logits_track_dense_model():
+    from music_analyst_tpu.models.layers import causal_mask
+    from music_analyst_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig(
+        vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        hidden_dim=64, rope_theta=1e4, max_seq_len=32, dtype="float32",
+    )
+    qcfg = dataclasses.replace(cfg, quant="int8")
+    model, qmodel = LlamaModel(cfg), LlamaModel(qcfg)
+    rng = np.random.default_rng(5)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 16)), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(16), (2, 16))
+    mask = causal_mask(16, 16, 0)
+    params = model.init(jax.random.key(0), ids, pos, mask)["params"]
+    dense_logits, _ = model.apply({"params": params}, ids, pos, mask)
+    quant_logits, _ = qmodel.apply({"params": params}, ids, pos, mask)
+    dense_logits = np.asarray(dense_logits)
+    quant_logits = np.asarray(quant_logits)
+    corr = np.corrcoef(dense_logits.ravel(), quant_logits.ravel())[0, 1]
+    assert corr > 0.99, corr
+
+
+def test_int8_llama_preset_suffix():
+    from music_analyst_tpu.models.llama import LlamaZeroShotClassifier
+
+    clf = LlamaZeroShotClassifier.from_pretrained_or_random(
+        "llama3-tiny-int8", max_prompt_len=64
+    )
+    assert clf.config.quant == "int8"
+    labels = clf.classify_batch(["la la love", ""])
+    assert labels[1] == "Neutral"
+
+
+def test_quant_dense_init_matches_dense_general_scale():
+    """Self-initialized quant modules must use DenseGeneral's flattened
+    fan-in, not raw lecun_normal on the 3-D shape (which under-scales
+    q/k/v kernels by sqrt(n_heads))."""
+    from flax import linen as nn
+
+    from music_analyst_tpu.models.layers import QuantDenseGeneral
+
+    x = jnp.zeros((2, 768))
+    dense = nn.DenseGeneral(features=(12, 64), axis=-1, name="d")
+    quant = QuantDenseGeneral(features=(12, 64), axis=-1, name="q")
+    kd = dense.init(jax.random.key(0), x)["params"]["kernel"]
+    kq = quant.init(jax.random.key(0), x)["params"]["kernel"]
+    assert kd.shape == kq.shape
+    ratio = np.std(np.asarray(kq)) / np.std(np.asarray(kd))
+    assert 0.8 < ratio < 1.25, ratio
+
+
+def test_int8_moe_config_refused():
+    import pytest
+
+    from music_analyst_tpu.models.layers import causal_mask
+    from music_analyst_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig(
+        vocab_size=64, dim=32, n_layers=1, n_heads=4, n_kv_heads=2,
+        hidden_dim=64, rope_theta=1e4, max_seq_len=32, n_experts=2,
+        quant="int8",
+    )
+    ids = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8), (1, 8))
+    with pytest.raises(NotImplementedError, match="MoE"):
+        LlamaModel(cfg).init(jax.random.key(0), ids, pos, causal_mask(8, 8, 0))
+
+
 def test_int8_classifier_end_to_end():
     from music_analyst_tpu.models.distilbert import DistilBertClassifier
 

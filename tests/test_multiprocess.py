@@ -104,3 +104,16 @@ def test_two_process_wordcount_matches_single_process(tmp_path):
     assert got == expect_path.read_bytes()
     total_songs = int(results[0].split()[1])
     assert total_songs == corpus.song_count
+
+    artist_counts = np.bincount(
+        corpus.artist_ids[corpus.artist_ids >= 0],
+        minlength=len(corpus.artist_vocab),
+    )
+    expect_artists = tmp_path / "expect_top_artists.csv"
+    write_count_csv(
+        str(expect_artists), "artist",
+        sort_count_entries(
+            corpus.artist_vocab.counts_to_entries(artist_counts)
+        ),
+    )
+    assert (out_dir / "top_artists.csv").read_bytes() == expect_artists.read_bytes()

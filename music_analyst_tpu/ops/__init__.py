@@ -2,5 +2,8 @@
 
 from music_analyst_tpu.ops.histogram import (
     sharded_histogram,
+    sharded_histogram_hostlocal,
+    sharded_histogram_hostlocal_timed,
     token_histogram,
 )
+from music_analyst_tpu.ops.quant import quant_matmul

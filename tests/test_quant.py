@@ -145,6 +145,46 @@ def test_int8_moe_config_refused():
         LlamaModel(cfg).init(jax.random.key(0), ids, pos, causal_mask(8, 8, 0))
 
 
+def test_int8_composes_with_flash_attention():
+    """quant touches only the projections; the flash kernel must slot in
+    unchanged between them."""
+    from music_analyst_tpu.models.distilbert import (
+        DistilBertConfig,
+        DistilBertForSentiment,
+    )
+
+    base = dataclasses.replace(
+        DistilBertConfig.tiny(), dtype="float32", quant="int8"
+    )
+    flash_cfg = dataclasses.replace(base, attn_impl="flash")
+    dense_model = DistilBertForSentiment(base)
+    flash_model = DistilBertForSentiment(flash_cfg)
+    rng = np.random.default_rng(7)
+    ids = jnp.asarray(rng.integers(0, base.vocab_size, (2, 64)), jnp.int32)
+    lengths = jnp.asarray([64, 40], jnp.int32)  # padded row: mask via lengths
+    params = dense_model.init(jax.random.key(0), ids, lengths)["params"]
+    dense_logits = np.asarray(
+        dense_model.apply({"params": params}, ids, lengths)
+    )
+    flash_logits = np.asarray(
+        flash_model.apply({"params": params}, ids, lengths)
+    )
+    # Same params, same quant math — only the attention formulation
+    # differs, so the two int8 forwards must agree tightly (incl. the
+    # padding-masked row).
+    np.testing.assert_allclose(flash_logits, dense_logits, rtol=2e-2,
+                               atol=2e-2)
+
+
+def test_int8_composes_with_kv_cache_decode():
+    from music_analyst_tpu.models.llama import LlamaConfig, LlamaZeroShotClassifier
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), quant="int8")
+    clf = LlamaZeroShotClassifier(config=cfg, max_prompt_len=32, seed=1)
+    outs = clf.generate_batch(["la la love", "rain"], max_new_tokens=4)
+    assert len(outs) == 2 and all(isinstance(o, str) for o in outs)
+
+
 def test_int8_classifier_end_to_end():
     from music_analyst_tpu.models.distilbert import DistilBertClassifier
 

@@ -38,6 +38,38 @@ print(f"RESULT {result['total_songs']} {result['total_words']}")
 """
 
 
+def test_distributed_wordcount_single_process_degenerates(tmp_path):
+    """With one process the same code path must reduce to the plain
+    engine result (every collective degrades per multihost.py)."""
+    import numpy as np
+
+    from music_analyst_tpu.data.csv_io import (
+        sort_count_entries,
+        write_count_csv,
+    )
+    from music_analyst_tpu.data.ingest import ingest_python
+    from music_analyst_tpu.data.synthetic import generate_dataset
+    from music_analyst_tpu.parallel.distributed import distributed_wordcount
+
+    dataset = tmp_path / "songs.csv"
+    generate_dataset(str(dataset), num_songs=60, seed=9)
+    result = distributed_wordcount(str(dataset), output_dir=str(tmp_path / "o"))
+    corpus = ingest_python(dataset.read_bytes())
+    assert result["processes"] == 1
+    assert result["total_songs"] == corpus.song_count
+    assert result["total_words"] == corpus.token_count
+    counts = np.bincount(
+        corpus.word_ids[corpus.word_ids >= 0],
+        minlength=len(corpus.word_vocab),
+    )
+    expect = tmp_path / "expect.csv"
+    write_count_csv(
+        str(expect), "word",
+        sort_count_entries(corpus.word_vocab.counts_to_entries(counts)),
+    )
+    assert (tmp_path / "o" / "word_counts.csv").read_bytes() == expect.read_bytes()
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("localhost", 0))

@@ -19,6 +19,7 @@ from __future__ import annotations
 import functools
 import json
 import os
+import tempfile
 from typing import List, Tuple
 
 import numpy as np
@@ -28,7 +29,7 @@ from music_analyst_tpu.data.csv_io import (
     sort_count_entries,
     write_count_csv,
 )
-from music_analyst_tpu.data.ingest import IngestResult, ingest_python
+from music_analyst_tpu.data.ingest import IngestResult, ingest_dataset
 from music_analyst_tpu.parallel import multihost
 
 
@@ -121,7 +122,13 @@ def distributed_wordcount(
     with open(dataset_path, "rb") as fh:
         data = fh.read()
     my_slice, _ = _my_record_range(data)
-    corpus: IngestResult = ingest_python(my_slice)
+    # Each process runs the full multithreaded C++ ingest on its slice
+    # (written to a scratch file — the native scanner is file-based);
+    # the pure-Python oracle is the fallback, as everywhere else.
+    with tempfile.NamedTemporaryFile(suffix=".csv") as tmp:
+        tmp.write(my_slice)
+        tmp.flush()
+        corpus: IngestResult = ingest_dataset(tmp.name)
 
     word_tokens = _merge_vocabs(corpus.word_vocab.tokens)
     artist_tokens = _merge_vocabs(corpus.artist_vocab.tokens)

@@ -70,6 +70,10 @@ def _add_sentiment(sub: argparse._SubParsersAction) -> None:
                         "sentiment_details.csv")
     p.add_argument("--trace-dir", default=None,
                    help="Capture an XLA/TPU profiler trace into this dir")
+    p.add_argument("--devices", type=int, default=None,
+                   help="Shard model-backend batches over the first N "
+                        "devices (dp); mesh-incapable backends "
+                        "(--mock, ollama) ignore it")
 
 
 def _add_wordcount_per_song(sub: argparse._SubParsersAction) -> None:
@@ -185,6 +189,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         from music_analyst_tpu.engines.sentiment import run_sentiment
         from music_analyst_tpu.metrics.tracing import maybe_trace
 
+        mesh = None
+        if args.devices:
+            from music_analyst_tpu.parallel.mesh import data_parallel_mesh
+
+            mesh = data_parallel_mesh(args.devices)
         with maybe_trace(args.trace_dir):
             run_sentiment(
                 args.dataset,
@@ -194,6 +203,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 output_dir=args.output_dir,
                 batch_size=args.batch_size,
                 resume=args.resume,
+                mesh=mesh,
             )
         return 0
 

@@ -82,6 +82,7 @@ def run_joint(
             batch_size=batch_size,
             quiet=quiet,
             songs=corpus.iter_records(),
+            mesh=mesh,
         )
     total = timer.total("ingest", "wordcount", "sentiment")
     songs_per_second = analysis.total_songs / total if total > 0 else 0.0

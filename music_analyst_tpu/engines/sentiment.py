@@ -149,6 +149,15 @@ def _read_completed_details(details_path: str) -> Tuple[int, Dict[str, int]]:
     return done, counts
 
 
+def _mesh_capable(model: str, mock: bool) -> bool:
+    """Whether the resolved backend family takes a device mesh (the
+    on-device model families do; the keyword kernel and the Ollama HTTP
+    passthrough do not)."""
+    return not mock and (
+        model.startswith("distilbert") or model.startswith("llama")
+    )
+
+
 def run_sentiment(
     dataset_path: str,
     model: str = "mock",
@@ -160,6 +169,7 @@ def run_sentiment(
     quiet: bool = False,
     resume: bool = False,
     songs: Optional[Iterable[Tuple[str, str, str]]] = None,
+    mesh=None,
 ) -> SentimentResult:
     """Classify the dataset and write the reference output artifacts.
 
@@ -192,7 +202,17 @@ def run_sentiment(
         )
 
         enable_persistent_compilation_cache()
-    clf = backend if backend is not None else get_backend(model, mock=mock)
+    if backend is not None:
+        clf = backend
+    else:
+        # mesh shards model-backend batches over dp and places params per
+        # the TP rules; mesh-incapable families (mock, ollama) ignore it.
+        kwargs = (
+            {"mesh": mesh}
+            if mesh is not None and _mesh_capable(model, mock)
+            else {}
+        )
+        clf = get_backend(model, mock=mock, **kwargs)
 
     totals_path = os.path.join(output_dir, "sentiment_totals.json")
     details_path = os.path.join(output_dir, "sentiment_details.csv")

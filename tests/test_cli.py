@@ -113,3 +113,14 @@ def test_analyze_trace_dir_writes_profile(fixture_csv, tmp_path, capsys):
     capsys.readouterr()
     trace_files = list((tmp_path / "trace").rglob("*"))
     assert any(f.is_file() for f in trace_files), trace_files
+
+
+def test_sentiment_devices_flag_builds_mesh_backend(fixture_csv, tmp_path):
+    rc = main([
+        "sentiment", str(fixture_csv), "--model", "distilbert-tiny",
+        "--devices", "4", "--output-dir", str(tmp_path),
+    ])
+    assert rc == 0
+    assert (tmp_path / "sentiment_totals.json").exists()
+    details = (tmp_path / "sentiment_details.csv").read_text()
+    assert details.count("\n") == 9  # header + 8 DictReader rows

@@ -42,3 +42,16 @@ def test_limit_respected(fixture_csv, tmp_path):
 def test_backend_dispatch():
     assert get_backend("llama3", mock=True).name == "mock"
     assert get_backend("mock").name == "mock"
+
+
+def test_mesh_capability_gate():
+    """mesh= must reach only the on-device model families; the keyword
+    kernel and the Ollama HTTP passthrough take no mesh kwarg."""
+    from music_analyst_tpu.engines.sentiment import _mesh_capable
+
+    assert _mesh_capable("distilbert", False)
+    assert _mesh_capable("distilbert-tiny-int8", False)
+    assert _mesh_capable("llama3-tiny", False)
+    assert not _mesh_capable("mock", False)
+    assert not _mesh_capable("distilbert", True)  # --mock wins
+    assert not _mesh_capable("ollama:llama3", False)

@@ -16,6 +16,21 @@ import sys
 from typing import List, Optional
 
 
+def _int_list(text: str) -> List[int]:
+    """argparse type for comma-separated positive ints (e.g. "32,64,128");
+    tolerates stray blanks, reports bad input as a usage error rather than
+    a traceback."""
+    try:
+        values = [int(x) for x in text.split(",") if x.strip()]
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected comma-separated integers, got {text!r}"
+        )
+    if not values:
+        raise argparse.ArgumentTypeError("expected at least one integer")
+    return values
+
+
 def _add_analyze(sub: argparse._SubParsersAction) -> None:
     p = sub.add_parser(
         "analyze",
@@ -74,6 +89,10 @@ def _add_sentiment(sub: argparse._SubParsersAction) -> None:
                    help="Shard model-backend batches over the first N "
                         "devices (dp); mesh-incapable backends "
                         "(--mock, ollama) ignore it")
+    p.add_argument("--length-buckets", type=_int_list, default=None,
+                   help="Comma-separated sequence-length buckets for the "
+                        "encoder classifier (e.g. 32,64,128): short songs "
+                        "run at shorter sequence lengths")
 
 
 def _add_wordcount_per_song(sub: argparse._SubParsersAction) -> None:
@@ -107,7 +126,7 @@ def _add_sweep(sub: argparse._SubParsersAction) -> None:
         help="scaling sweep over device counts (run_performance.sh analogue)",
     )
     p.add_argument("dataset")
-    p.add_argument("--devices", default=None,
+    p.add_argument("--devices", type=_int_list, default=None,
                    help="Comma-separated device counts (default: 1,2,4,8 capped)")
     p.add_argument("--output-dir", default="output")
     p.add_argument("--ingest", choices=("auto", "native", "python"), default="auto")
@@ -129,12 +148,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.command == "sweep":
         from music_analyst_tpu.engines.sweep import run_sweep
 
-        counts = (
-            [int(x) for x in args.devices.split(",")] if args.devices else None
-        )
         summary = run_sweep(
             args.dataset,
-            device_counts=counts,
+            device_counts=args.devices,
             output_dir=args.output_dir,
             ingest_backend=args.ingest,
             quiet=False,
@@ -191,9 +207,14 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         mesh = None
         if args.devices:
-            from music_analyst_tpu.parallel.mesh import data_parallel_mesh
+            from music_analyst_tpu.engines.sentiment import _mesh_capable
 
-            mesh = data_parallel_mesh(args.devices)
+            # Don't initialize the device backend (tunnel round-trip on
+            # axon) just to build a mesh the backend family can't take.
+            if _mesh_capable(args.model, args.mock):
+                from music_analyst_tpu.parallel.mesh import data_parallel_mesh
+
+                mesh = data_parallel_mesh(args.devices)
         with maybe_trace(args.trace_dir):
             run_sentiment(
                 args.dataset,
@@ -204,6 +225,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 batch_size=args.batch_size,
                 resume=args.resume,
                 mesh=mesh,
+                length_buckets=args.length_buckets,
             )
         return 0
 

@@ -70,13 +70,31 @@ class ClassifierBackend:
         return handle
 
 
-def get_backend(model: str, mock: bool = False, **kwargs) -> ClassifierBackend:
+def get_backend(
+    model: str,
+    mock: bool = False,
+    mesh=None,
+    length_buckets: Optional[Sequence[int]] = None,
+    **kwargs,
+) -> ClassifierBackend:
     """Resolve the ``--model``/``--mock`` flag surface to a backend.
 
     Mirrors the reference's dispatch (``--mock`` wins over ``--model``,
     ``scripts/sentiment_classifier.py:140``); model names map to on-device
     families instead of Ollama model tags.
+
+    The dispatch also owns per-family capabilities, so callers pass
+    ``mesh``/``length_buckets`` unconditionally: ``mesh`` shards model
+    batches over dp and places params per the TP rules but is dropped for
+    the mesh-incapable families (the keyword kernel, the Ollama HTTP
+    passthrough); ``length_buckets`` is encoder-only and *raises* elsewhere
+    (silently running every row at full length would defeat the flag).
     """
+    if length_buckets and (mock or not model.startswith("distilbert")):
+        raise ValueError(
+            "length_buckets is an encoder-classifier option; "
+            f"model {model!r} does not support it"
+        )
     if mock or model == "mock":
         from music_analyst_tpu.models.mock import MockKeywordClassifier
 
@@ -86,10 +104,14 @@ def get_backend(model: str, mock: bool = False, **kwargs) -> ClassifierBackend:
 
         tag = model.split(":", 1)[1] if ":" in model else "llama3"
         return OllamaClassifier(model=tag, **kwargs)
+    if mesh is not None:
+        kwargs["mesh"] = mesh
     try:
         if model.startswith("distilbert"):
             from music_analyst_tpu.models.distilbert import DistilBertClassifier
 
+            if length_buckets:
+                kwargs["length_buckets"] = tuple(length_buckets)
             return DistilBertClassifier.from_pretrained_or_random(model, **kwargs)
         if model.startswith("llama"):
             from music_analyst_tpu.models.llama import LlamaZeroShotClassifier
@@ -152,7 +174,10 @@ def _read_completed_details(details_path: str) -> Tuple[int, Dict[str, int]]:
 def _mesh_capable(model: str, mock: bool) -> bool:
     """Whether the resolved backend family takes a device mesh (the
     on-device model families do; the keyword kernel and the Ollama HTTP
-    passthrough do not)."""
+    passthrough do not).  Callers that just want a backend should pass
+    ``mesh=`` to :func:`get_backend`, which drops it where inapplicable;
+    this predicate exists for callers deciding whether to *build* a mesh
+    at all (mesh construction initializes the device backend)."""
     return not mock and (
         model.startswith("distilbert") or model.startswith("llama")
     )
@@ -170,6 +195,7 @@ def run_sentiment(
     resume: bool = False,
     songs: Optional[Iterable[Tuple[str, str, str]]] = None,
     mesh=None,
+    length_buckets: Optional[Sequence[int]] = None,
 ) -> SentimentResult:
     """Classify the dataset and write the reference output artifacts.
 
@@ -203,16 +229,18 @@ def run_sentiment(
 
         enable_persistent_compilation_cache()
     if backend is not None:
+        if mesh is not None or length_buckets:
+            # An injected backend was constructed by the caller; silently
+            # dropping construction-time options here would be a lie.
+            raise ValueError(
+                "mesh=/length_buckets= configure backend construction and "
+                "cannot be combined with an explicit backend="
+            )
         clf = backend
     else:
-        # mesh shards model-backend batches over dp and places params per
-        # the TP rules; mesh-incapable families (mock, ollama) ignore it.
-        kwargs = (
-            {"mesh": mesh}
-            if mesh is not None and _mesh_capable(model, mock)
-            else {}
+        clf = get_backend(
+            model, mock=mock, mesh=mesh, length_buckets=length_buckets
         )
-        clf = get_backend(model, mock=mock, **kwargs)
 
     totals_path = os.path.join(output_dir, "sentiment_totals.json")
     details_path = os.path.join(output_dir, "sentiment_details.csv")

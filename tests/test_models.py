@@ -60,6 +60,95 @@ class TestDistilBert:
         assert clf.classify_batch(["anything at all"]) == ["Neutral"]
 
 
+class TestDistilBertLengthBuckets:
+    """Bucketed inference: same labels, shorter compiled sequences."""
+
+    def _mixed_texts(self):
+        return [
+            "short",
+            "",
+            "a medium length lyric with a handful of words in it",
+            "long " + "word " * 60,
+            "tiny one",
+            "another long lyric " + "la la love rain " * 20,
+        ]
+
+    def test_matches_unbucketed_float32(self):
+        """In float32 the bucketed path is numerically the unbucketed path
+        (padding invariance), so labels must agree exactly."""
+        import dataclasses
+
+        cfg = dataclasses.replace(DistilBertConfig.tiny(), dtype="float32")
+        plain = DistilBertClassifier(config=cfg, max_len=64, seed=5)
+        bucketed = DistilBertClassifier(
+            config=cfg, max_len=64, seed=5, length_buckets=(16, 32)
+        )
+        bucketed.params = plain.params
+        texts = self._mixed_texts() * 3
+        assert bucketed.classify_batch(texts) == plain.classify_batch(texts)
+
+    def test_routing_and_order_restoration(self):
+        """Every row routes to the smallest sufficient bucket and comes
+        back in input order (deterministic fake forward)."""
+        clf = DistilBertClassifier(
+            config=DistilBertConfig.tiny(), max_len=64,
+            length_buckets=(16, 32), neutral_threshold=0.5,
+        )
+        seen_seqs = []
+
+        def fake_forward(params, token_ids, lengths):
+            seen_seqs.append(token_ids.shape[1])
+            # class = row length parity; confidence = certain
+            return np.asarray(lengths) % 2, np.ones(lengths.shape[0])
+
+        clf._forward = fake_forward
+        texts = self._mixed_texts()
+        _, lengths = clf.tokenizer.encode_batch(texts, clf.max_len)
+        labels = clf.classify_batch(texts)
+        want = [
+            "Neutral" if not t.strip()
+            else clf._CLASS_LABELS[int(n) % 2]
+            for t, n in zip(texts, lengths)
+        ]
+        assert labels == want
+        assert set(seen_seqs) <= {16, 32, 64}
+        assert len(seen_seqs) >= 2  # mixed lengths hit multiple buckets
+
+    def test_single_bucket_when_all_short(self):
+        clf = DistilBertClassifier(
+            config=DistilBertConfig.tiny(), max_len=64, length_buckets=(16,)
+        )
+        seen = []
+        real = clf._forward
+        clf._forward = lambda p, i, l: (seen.append(i.shape), real(p, i, l))[1]
+        clf.classify_batch(["hi there", "la la", "ok"])
+        assert all(shape[1] == 16 for shape in seen)
+        # rows round up to the power-of-two floor
+        assert all(shape[0] == 16 for shape in seen)
+
+    def test_bucketed_on_dp_mesh(self):
+        mesh = build_mesh(factor_devices(8, ("dp",)))
+        clf = DistilBertClassifier(
+            config=DistilBertConfig.tiny(), max_len=64, mesh=mesh,
+            length_buckets=(16, 32),
+        )
+        labels = clf.classify_batch(self._mixed_texts())
+        assert len(labels) == 6
+        assert all(l in SUPPORTED_LABELS for l in labels)
+        assert labels[1] == "Neutral"
+
+    def test_bucket_validation(self):
+        with pytest.raises(ValueError, match="floor"):
+            DistilBertClassifier(
+                config=DistilBertConfig.tiny(), max_len=64, length_buckets=(4,)
+            )
+        with pytest.raises(ValueError, match="exceeds max_len"):
+            DistilBertClassifier(
+                config=DistilBertConfig.tiny(), max_len=64,
+                length_buckets=(128,),
+            )
+
+
 class TestLlama:
     @pytest.fixture(scope="class")
     def clf(self):

@@ -44,6 +44,31 @@ def test_backend_dispatch():
     assert get_backend("mock").name == "mock"
 
 
+def test_length_buckets_end_to_end(fixture_csv, tmp_path):
+    """Bucketed encoder run produces the full artifact set with one label
+    per dataset row."""
+    result = run_sentiment(
+        str(fixture_csv),
+        model="distilbert-tiny",
+        output_dir=str(tmp_path),
+        quiet=True,
+        length_buckets=(16, 32),
+        batch_size=4,
+    )
+    assert sum(result.counts.values()) == len(result.rows) > 0
+    assert (tmp_path / "sentiment_totals.json").exists()
+
+
+def test_length_buckets_rejected_for_non_encoder(fixture_csv, tmp_path):
+    import pytest
+
+    with pytest.raises(ValueError, match="encoder-classifier"):
+        run_sentiment(
+            str(fixture_csv), mock=True, output_dir=str(tmp_path),
+            quiet=True, length_buckets=(16,),
+        )
+
+
 def test_mesh_capability_gate():
     """mesh= must reach only the on-device model families; the keyword
     kernel and the Ollama HTTP passthrough take no mesh kwarg."""

@@ -16,6 +16,15 @@ poisons the parent's backend cache), transient UNAVAILABLE tunnel errors
 get bounded retries with backoff, and a terminal failure still emits the
 contractual line with an ``error`` field.
 
+The whole parent — attempts, backoffs, and the terminal error line — runs
+under ONE wall-clock deadline (``MUSICAAL_BENCH_DEADLINE_S``, default
+480 s), chosen to sit well inside the round driver's own budget: round 3's
+retry loop could out-wait its caller (worst case ~44 min), so the driver
+killed it at rc 124 and the "always one JSON line" contract never executed.
+Attempt timeouts and retry sleeps now shrink to whatever budget remains,
+and the error line is emitted *before* the deadline, never after.
+``tests/test_bench_budget.py`` pins the worst case.
+
 Additional suites backing PERFORMANCE.md live in ``benchmarks/`` (see
 ``python bench.py --list-suites``).
 """
@@ -24,6 +33,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import subprocess
 import sys
@@ -31,10 +41,37 @@ import time
 
 PER_CHIP_TARGET = 16_667 / 8  # songs/sec per chip for the <60s/1M goal
 METRIC = "sentiment_songs_per_sec_distilbert"
+# One wall-clock budget for the WHOLE parent: attempts + backoffs + the
+# terminal error line all fit inside it.  Must stay well under the round
+# driver's own timeout or the contractual line never reaches stdout.
+_DEFAULT_DEADLINE_S = 480.0
+
+
+def _env_deadline() -> float:
+    # A malformed override must not crash before the contractual line can
+    # be emitted, and a non-finite/non-positive one must not disable the
+    # deadline — fall back to the default instead.
+    try:
+        value = float(os.environ["MUSICAAL_BENCH_DEADLINE_S"])
+    except (KeyError, ValueError):
+        return _DEFAULT_DEADLINE_S
+    return value if math.isfinite(value) and value > 0 else _DEFAULT_DEADLINE_S
+
+
+OVERALL_DEADLINE_S = _env_deadline()
+# Per-attempt cap: first axon compile is slow (~20-40 s) but a healthy run
+# finishes in well under 2 min; a child still silent at 5 min is wedged.
+ATTEMPT_CAP_S = 300.0
+# Don't launch an attempt that couldn't cover a cold compile + the 16k-song
+# sweep: SIGKILLing a child mid-compile wedges the axon lease (CLAUDE.md),
+# which is worse than giving up cleanly.
+MIN_ATTEMPT_S = 150.0
+# Reserved tail for collecting the child + printing the terminal line.
+SAFETY_S = 15.0
 # Backoff before retrying a failed attempt.  The axon loopback tunnel's
-# UNAVAILABLE is frequently transient but a wedged device lease can take
-# minutes to clear (CLAUDE.md), so the gaps grow aggressively.
-RETRY_SLEEPS = (20, 60, 180)
+# UNAVAILABLE is frequently transient; a wedged lease can take longer than
+# this whole budget to clear, in which case the error line IS the result.
+RETRY_SLEEPS = (10.0, 30.0, 60.0)
 
 
 def measure() -> dict:
@@ -108,38 +145,80 @@ def _last_json_line(text: str) -> dict | None:
     return None
 
 
-def _run_parent(attempts: int) -> int:
-    last_error = "no attempts ran"
-    for attempt in range(attempts):
+def _salvage(stdout, *, require_metric: bool) -> bool:
+    """Print a child's result line if its stdout carries one.
+
+    ``require_metric`` gates on the headline metric name for children that
+    did not exit cleanly, so a stray JSON line can't masquerade as success.
+    """
+    if isinstance(stdout, bytes):
+        stdout = stdout.decode(errors="replace")
+    result = _last_json_line(stdout or "")
+    if result is None or (require_metric and result.get("metric") != METRIC):
+        return False
+    print(json.dumps(result))
+    return True
+
+
+def _run_parent(
+    attempts: int,
+    deadline_s: float | None = None,
+    *,
+    run=subprocess.run,
+    sleep=time.sleep,
+    clock=time.monotonic,
+) -> int:
+    """Attempt the measurement under one hard wall-clock deadline.
+
+    ``run``/``sleep``/``clock`` are injectable so the budget test can pin
+    the worst case with a fake clock instead of real minutes.
+    """
+    if deadline_s is None:
+        deadline_s = OVERALL_DEADLINE_S
+    start = clock()
+
+    def remaining() -> float:
+        return deadline_s - (clock() - start)
+
+    last_error = "no attempt fit inside the deadline"
+    attempt = 0
+    while attempt < attempts and remaining() - SAFETY_S >= MIN_ATTEMPT_S:
+        budget = min(ATTEMPT_CAP_S, remaining() - SAFETY_S)
         try:
-            proc = subprocess.run(
+            proc = run(
                 [sys.executable, os.path.abspath(__file__), "--child"],
                 capture_output=True,
                 text=True,
-                # Generous: first axon compile is slow and killing it can
-                # wedge the device lease — but a dead tunnel must not hang
-                # the driver forever.
-                timeout=600,
+                timeout=budget,
             )
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as exc:
             proc = None
-            last_error = "attempt timed out after 600s (tunnel hang?)"
+            # A child can print the result line and then hang in interpreter
+            # teardown (axon tunnel threads) — salvage its stdout before
+            # writing the attempt off.
+            if _salvage(exc.stdout, require_metric=True):
+                return 0
+            last_error = f"attempt timed out after {budget:.0f}s (tunnel hang?)"
         if proc is not None:
-            result = (
-                _last_json_line(proc.stdout) if proc.returncode == 0 else None
-            )
-            if result is not None:
-                print(json.dumps(result))
+            # A completed measurement counts even when the interpreter died
+            # non-zero afterwards (axon teardown) — same salvage rule as the
+            # timeout path.
+            if _salvage(proc.stdout, require_metric=proc.returncode != 0):
                 return 0
             tail = (proc.stderr or proc.stdout or "").strip().splitlines()
             last_error = (
                 " | ".join(tail[-3:]) if tail else f"rc={proc.returncode}"
             )
-        # Backoff applies to timeouts too — killing a child mid-compile is
-        # exactly the case that wedges the lease and needs the longest gap.
-        if attempt + 1 < attempts:
-            time.sleep(RETRY_SLEEPS[min(attempt, len(RETRY_SLEEPS) - 1)])
-    # Terminal failure: still exactly one parseable JSON line.
+        attempt += 1
+        # Backoff (a killed mid-compile child wedges the lease and wants a
+        # gap) — but only what the remaining budget can afford: sleeping
+        # past the point where another attempt fits would waste the tail.
+        gap = RETRY_SLEEPS[min(attempt - 1, len(RETRY_SLEEPS) - 1)]
+        affordable = remaining() - SAFETY_S - MIN_ATTEMPT_S
+        if attempt < attempts and affordable > 0:
+            sleep(min(gap, affordable))
+    # Terminal failure: still exactly one parseable JSON line, emitted
+    # BEFORE the deadline (the loop guard guarantees ≥ SAFETY_S remains).
     print(
         json.dumps(
             {
@@ -148,6 +227,7 @@ def _run_parent(attempts: int) -> int:
                 "unit": "songs/sec (benchmark failed; see error)",
                 "vs_baseline": 0.0,
                 "error": last_error[-800:],
+                "gave_up_after_s": round(clock() - start, 1),
             }
         )
     )
@@ -160,6 +240,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--attempts", type=int, default=4,
         help="Max measurement attempts before emitting the error line",
+    )
+    parser.add_argument(
+        "--deadline", type=float, default=None,
+        help="Overall wall-clock budget in seconds (default "
+             "$MUSICAAL_BENCH_DEADLINE_S or 480); the contractual JSON "
+             "line is always emitted before it elapses",
     )
     parser.add_argument(
         "--suite", default=None,
@@ -178,7 +264,7 @@ def main(argv: list[str] | None = None) -> int:
         return run_suite(args.suite)
     if args.child:
         return _run_child()
-    return _run_parent(args.attempts)
+    return _run_parent(args.attempts, args.deadline)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,181 @@
+"""Pin bench.py's wall-clock budget (VERDICT r3 weak #1).
+
+Round 3's driver capture died at rc 124 because the retry loop's worst case
+(~44 min) exceeded the driver's own timeout, so the "always one JSON line"
+contract never executed.  These tests drive ``_run_parent`` with a fake
+clock/sleep/run to prove the worst case — every attempt hanging until its
+timeout — still emits the contractual error line BEFORE the overall
+deadline, and a real-time smoke check proves the same end-to-end with a
+deliberately broken child.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, s):
+        self.now += s
+
+
+def _parse_only_line(capsys) -> dict:
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, f"expected exactly one stdout line, got {out!r}"
+    return json.loads(out[0])
+
+
+def test_worst_case_all_attempts_hang_fits_deadline(capsys):
+    """Every attempt times out; the error line lands before the deadline."""
+    clock = FakeClock()
+    timeouts = []
+
+    def hang_run(cmd, capture_output, text, timeout):
+        timeouts.append(timeout)
+        clock.advance(timeout)
+        raise subprocess.TimeoutExpired(cmd, timeout)
+
+    rc = bench._run_parent(
+        4, bench._DEFAULT_DEADLINE_S,
+        run=hang_run, sleep=clock.advance, clock=clock,
+    )
+    assert rc == 0
+    line = _parse_only_line(capsys)
+    assert line["metric"] == bench.METRIC
+    assert line["value"] == 0.0
+    assert "timed out" in line["error"]
+    # The contract the driver relies on: line printed with SAFETY_S spare.
+    assert clock.now <= bench._DEFAULT_DEADLINE_S - bench.SAFETY_S + 1e-6
+    # At least two genuine tries happened before giving up.
+    assert len(timeouts) >= 2
+    # No single attempt may exceed its cap or the remaining budget.
+    assert all(t <= bench.ATTEMPT_CAP_S for t in timeouts)
+
+
+def test_worst_case_slow_failures_fit_deadline(capsys):
+    """Attempts that FAIL just under their timeout (rc != 0) also fit."""
+    clock = FakeClock()
+
+    def slow_fail_run(cmd, capture_output, text, timeout):
+        clock.advance(timeout - 1.0)
+        return subprocess.CompletedProcess(
+            cmd, returncode=1, stdout="", stderr="RuntimeError: UNAVAILABLE"
+        )
+
+    rc = bench._run_parent(
+        4, bench._DEFAULT_DEADLINE_S,
+        run=slow_fail_run, sleep=clock.advance, clock=clock,
+    )
+    assert rc == 0
+    line = _parse_only_line(capsys)
+    assert "UNAVAILABLE" in line["error"]
+    assert clock.now <= bench._DEFAULT_DEADLINE_S
+
+
+def test_constants_leave_room_for_an_attempt():
+    """The default deadline admits at least one full-cap attempt plus the
+    reserved tail — otherwise the headline could never be measured."""
+    assert bench._DEFAULT_DEADLINE_S >= bench.ATTEMPT_CAP_S + bench.SAFETY_S
+    # And the deadline sits well under the driver budget that killed r3
+    # (>= 10 min): leave at least 2 minutes of margin.
+    assert bench._DEFAULT_DEADLINE_S <= 480
+
+
+def test_timed_out_child_stdout_is_salvaged(capsys):
+    """A child that printed its result line and then hung at teardown
+    (axon tunnel threads) must still count as a success."""
+    clock = FakeClock()
+    payload = {"metric": bench.METRIC, "value": 321.0,
+               "unit": "songs/sec", "vs_baseline": 0.2}
+
+    def hang_after_print(cmd, capture_output, text, timeout):
+        clock.advance(timeout)
+        raise subprocess.TimeoutExpired(
+            cmd, timeout, output=json.dumps(payload) + "\n"
+        )
+
+    rc = bench._run_parent(
+        4, bench._DEFAULT_DEADLINE_S,
+        run=hang_after_print, sleep=clock.advance, clock=clock,
+    )
+    assert rc == 0
+    assert _parse_only_line(capsys) == payload
+
+
+def test_nonzero_exit_after_result_line_is_salvaged(capsys):
+    """Same salvage rule when the child prints the line but exits rc!=0
+    (teardown crash instead of hang)."""
+    clock = FakeClock()
+    payload = {"metric": bench.METRIC, "value": 77.0,
+               "unit": "songs/sec", "vs_baseline": 0.05}
+
+    def crash_after_print(cmd, capture_output, text, timeout):
+        clock.advance(40.0)
+        return subprocess.CompletedProcess(
+            cmd, returncode=1,
+            stdout=json.dumps(payload) + "\n",
+            stderr="Fatal Python error during teardown",
+        )
+
+    rc = bench._run_parent(
+        4, bench._DEFAULT_DEADLINE_S,
+        run=crash_after_print, sleep=clock.advance, clock=clock,
+    )
+    assert rc == 0
+    assert _parse_only_line(capsys) == payload
+
+
+def test_malformed_deadline_env_falls_back(monkeypatch):
+    for bad in ("8min", "inf", "nan", "-5", "0"):
+        monkeypatch.setenv("MUSICAAL_BENCH_DEADLINE_S", bad)
+        assert bench._env_deadline() == bench._DEFAULT_DEADLINE_S, bad
+    monkeypatch.setenv("MUSICAAL_BENCH_DEADLINE_S", "240")
+    assert bench._env_deadline() == 240.0
+
+
+def test_success_passes_through(capsys):
+    clock = FakeClock()
+    payload = {"metric": bench.METRIC, "value": 123.4,
+               "unit": "songs/sec", "vs_baseline": 0.1}
+
+    def ok_run(cmd, capture_output, text, timeout):
+        clock.advance(30.0)
+        return subprocess.CompletedProcess(
+            cmd, returncode=0, stdout=json.dumps(payload) + "\n", stderr=""
+        )
+
+    rc = bench._run_parent(
+        4, bench._DEFAULT_DEADLINE_S,
+        run=ok_run, sleep=clock.advance, clock=clock,
+    )
+    assert rc == 0
+    assert _parse_only_line(capsys) == payload
+
+
+def test_real_subprocess_tiny_deadline_emits_line():
+    """End-to-end: a 3 s deadline cannot fit MIN_ATTEMPT_S, so the parent
+    must emit the error line immediately, in real time."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(bench.__file__),
+                                      "bench.py"),
+         "--deadline", "3"],
+        capture_output=True, text=True, timeout=30,
+    )
+    assert proc.returncode == 0
+    lines = proc.stdout.strip().splitlines()
+    assert len(lines) == 1
+    parsed = json.loads(lines[0])
+    assert parsed["metric"] == bench.METRIC
+    assert parsed["value"] == 0.0

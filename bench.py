@@ -97,7 +97,11 @@ def measure() -> dict:
         generate_dataset(dataset, num_songs=n_songs, seed=11)
     texts = [text for _, _, text in iter_songs(dataset)]
 
-    clf = DistilBertClassifier(max_len=128)
+    # Auto length bucketing: derives buckets from the first batch's token
+    # lengths and only keeps ones worth a compiled shape.  On this corpus
+    # (~84% of rows at the seq-128 cap) it resolves to the flat path —
+    # measured either way by the `bucketing` suite.
+    clf = DistilBertClassifier(max_len=128, length_buckets="auto")
     batch = 8192  # measured best on v5e: ~10% over 4096 (amortizes dispatch)
 
     # Warmup: compile + first dispatch.
@@ -126,6 +130,7 @@ def measure() -> dict:
             "host tokenize included"
         ),
         "vs_baseline": round(songs_per_sec / (PER_CHIP_TARGET * n_chips), 3),
+        "length_buckets": list(clf.length_buckets or ()),
     }
 
 

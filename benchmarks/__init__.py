@@ -32,6 +32,7 @@ _SUITE_MODULES = (
     "benchmarks.joint",
     "benchmarks.llama_zeroshot",
     "benchmarks.sentiment_int8",
+    "benchmarks.bucketing",
 )
 
 

@@ -28,7 +28,20 @@ def _int_list(text: str) -> List[int]:
         )
     if not values:
         raise argparse.ArgumentTypeError("expected at least one integer")
+    bad = [v for v in values if v < 1]
+    if bad:
+        raise argparse.ArgumentTypeError(
+            f"expected positive integers, got {bad[0]}"
+        )
     return values
+
+
+def _buckets_arg(text: str):
+    """``--length-buckets`` value: explicit comma-separated lengths, or
+    ``auto`` to derive them from the first batch's length distribution."""
+    if text.strip().lower() == "auto":
+        return "auto"
+    return _int_list(text)
 
 
 def _add_analyze(sub: argparse._SubParsersAction) -> None:
@@ -89,10 +102,12 @@ def _add_sentiment(sub: argparse._SubParsersAction) -> None:
                    help="Shard model-backend batches over the first N "
                         "devices (dp); mesh-incapable backends "
                         "(--mock, ollama) ignore it")
-    p.add_argument("--length-buckets", type=_int_list, default=None,
-                   help="Comma-separated sequence-length buckets for the "
-                        "encoder classifier (e.g. 32,64,128): short songs "
-                        "run at shorter sequence lengths")
+    p.add_argument("--length-buckets", type=_buckets_arg, default=None,
+                   help="Sequence-length buckets for the encoder "
+                        "classifier: comma-separated lengths (e.g. "
+                        "32,64,128) or 'auto' to derive them from the "
+                        "corpus; short songs run at shorter sequence "
+                        "lengths")
 
 
 def _add_wordcount_per_song(sub: argparse._SubParsersAction) -> None:
@@ -205,6 +220,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         from music_analyst_tpu.engines.sentiment import run_sentiment
         from music_analyst_tpu.metrics.tracing import maybe_trace
 
+        # Fail as a usage error, not a mid-run traceback: buckets only
+        # apply to the encoder classifier family (engines/sentiment.py
+        # raises the same constraint later for programmatic callers).
+        if args.length_buckets and (
+            args.mock or not args.model.startswith("distilbert")
+        ):
+            parser.error(
+                "--length-buckets requires --model distilbert[-*] "
+                "(not --mock or decoder models)"
+            )
         mesh = None
         if args.devices:
             from music_analyst_tpu.engines.sentiment import _mesh_capable

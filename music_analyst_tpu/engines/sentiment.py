@@ -90,7 +90,13 @@ def get_backend(
     passthrough); ``length_buckets`` is encoder-only and *raises* elsewhere
     (silently running every row at full length would defeat the flag).
     """
-    if length_buckets and (mock or not model.startswith("distilbert")):
+    # `len(...)` (not truthiness) so numpy arrays work as sequences;
+    # strings ("auto" or a mistaken "32,64") defer to the classifier's
+    # own validation for a clear message.
+    has_buckets = length_buckets is not None and (
+        isinstance(length_buckets, str) or len(length_buckets) > 0
+    )
+    if has_buckets and (mock or not model.startswith("distilbert")):
         raise ValueError(
             "length_buckets is an encoder-classifier option; "
             f"model {model!r} does not support it"
@@ -110,8 +116,13 @@ def get_backend(
         if model.startswith("distilbert"):
             from music_analyst_tpu.models.distilbert import DistilBertClassifier
 
-            if length_buckets:
-                kwargs["length_buckets"] = tuple(length_buckets)
+            if has_buckets:
+                # Strings pass through (the classifier validates "auto" vs
+                # mistakes); a sequence is normalized to a tuple.
+                kwargs["length_buckets"] = (
+                    length_buckets if isinstance(length_buckets, str)
+                    else tuple(int(b) for b in length_buckets)
+                )
             return DistilBertClassifier.from_pretrained_or_random(model, **kwargs)
         if model.startswith("llama"):
             from music_analyst_tpu.models.llama import LlamaZeroShotClassifier
@@ -229,7 +240,7 @@ def run_sentiment(
 
         enable_persistent_compilation_cache()
     if backend is not None:
-        if mesh is not None or length_buckets:
+        if mesh is not None or length_buckets is not None:
             # An injected backend was constructed by the caller; silently
             # dropping construction-time options here would be a lie.
             raise ValueError(

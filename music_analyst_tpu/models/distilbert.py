@@ -188,6 +188,36 @@ def load_hf_torch_checkpoint(params, path: str):
     return new
 
 
+def derive_length_buckets(
+    lengths,
+    max_len: int,
+    min_share: float = 0.05,
+    floor: int = 16,
+) -> Tuple[int, ...]:
+    """Pick power-of-two sequence buckets from an observed length sample.
+
+    Data-driven default for the SURVEY §7 "ragged lyrics" lever: each kept
+    bucket must absorb at least ``min_share`` of the sampled rows — a bucket
+    costs one compiled program per batch shape, and one holding few rows
+    saves negligible FLOPs.  Rows skipped by a dropped bucket roll upward
+    into the next candidate.  Returns ``()`` when the sample is dominated
+    by full-length rows (real lyric corpora mostly are at ``max_len`` 128):
+    the flat path is then already optimal, and auto mode stays flat.
+    """
+    lengths = np.asarray(lengths)
+    out = []
+    if lengths.size:
+        prev = 0
+        b = floor
+        while b < max_len:
+            share = float(((lengths > prev) & (lengths <= b)).mean())
+            if share >= min_share:
+                out.append(b)
+                prev = b
+            b <<= 1
+    return tuple(out)
+
+
 class DistilBertClassifier(ClassifierBackend):
     """Batched data-parallel sentiment backend.
 
@@ -223,7 +253,19 @@ class DistilBertClassifier(ClassifierBackend):
         self.config = config or DistilBertConfig()
         self.max_len = max_len
         self.neutral_threshold = neutral_threshold
-        self.length_buckets = self._check_buckets(length_buckets, max_len)
+        # "auto" defers to the first submitted batch's length distribution
+        # (resolved via derive_length_buckets); a sequence is validated now.
+        if isinstance(length_buckets, str):
+            if length_buckets != "auto":
+                # Catch the CLI syntax leaking into the API: tuple("32,64")
+                # would otherwise iterate characters and raise nonsense.
+                raise ValueError(
+                    "length_buckets must be 'auto' or a sequence of ints, "
+                    f"got the string {length_buckets!r}"
+                )
+            self.length_buckets = "auto"
+        else:
+            self.length_buckets = self._check_buckets(length_buckets, max_len)
         self.tokenizer = resolve_bert_tokenizer(
             vocab_path, vocab_size=self.config.vocab_size
         )
@@ -339,6 +381,12 @@ class DistilBertClassifier(ClassifierBackend):
         :meth:`collect`.
         """
         token_ids, lengths = self.tokenizer.encode_batch(texts, self.max_len)
+        if self.length_buckets == "auto":
+            # First batch is the sample: at production batch sizes (4-8k
+            # rows) its length distribution is the corpus's.
+            self.length_buckets = self._check_buckets(
+                derive_length_buckets(lengths, self.max_len), self.max_len
+            )
         if self.length_buckets is None:
             return texts, [(None, *self._dispatch(token_ids, lengths))]
         parts = []
@@ -360,13 +408,21 @@ class DistilBertClassifier(ClassifierBackend):
 
     def collect(self, handle) -> List[str]:
         texts, parts = handle
-        classes = np.empty((len(texts),), np.int64)
+        # Sentinel init + coverage check: every row must be written by
+        # exactly one bucket part, or labels would silently be garbage.
+        classes = np.full((len(texts),), -1, np.int64)
         confidence = np.empty((len(texts),), np.float64)
         for rows, part_classes, part_confidence, n in parts:
             if rows is None:
                 rows = np.arange(len(texts))
             classes[rows] = np.asarray(part_classes)[:n]
             confidence[rows] = np.asarray(part_confidence)[:n]
+        uncovered = np.flatnonzero(classes < 0)
+        if uncovered.size:
+            raise AssertionError(
+                f"{uncovered.size} row(s) not covered by any length bucket "
+                f"(first: {uncovered[0]})"
+            )
         labels: List[str] = []
         for text, cls_id, conf in zip(texts, classes, confidence):
             if not text.strip():

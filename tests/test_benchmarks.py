@@ -20,7 +20,7 @@ def test_every_advertised_module_registers(monkeypatch):
     assert len(names) >= len(benchmarks._SUITE_MODULES)
     for expected in (
         "roofline", "flash_sweep", "generation", "coldstart", "ingest",
-        "scaling", "joint", "llama_zeroshot", "sentiment_int8",
+        "scaling", "joint", "llama_zeroshot", "sentiment_int8", "bucketing",
     ):
         assert expected in names
 
@@ -28,7 +28,7 @@ def test_every_advertised_module_registers(monkeypatch):
 @pytest.mark.parametrize(
     "name",
     ["roofline", "flash_sweep", "generation", "ingest", "joint",
-     "llama_zeroshot", "sentiment_int8"],
+     "llama_zeroshot", "sentiment_int8", "bucketing"],
 )
 def test_suite_runs_smoke(name, monkeypatch):
     monkeypatch.setenv("MUSICAAL_BENCH_SMOKE", "1")

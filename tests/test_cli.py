@@ -124,3 +124,30 @@ def test_sentiment_devices_flag_builds_mesh_backend(fixture_csv, tmp_path):
     assert (tmp_path / "sentiment_totals.json").exists()
     details = (tmp_path / "sentiment_details.csv").read_text()
     assert details.count("\n") == 9  # header + 8 DictReader rows
+
+
+def test_sentiment_length_buckets_auto(fixture_csv, tmp_path):
+    rc = main([
+        "sentiment", str(fixture_csv), "--model", "distilbert-tiny",
+        "--length-buckets", "auto", "--output-dir", str(tmp_path),
+    ])
+    assert rc == 0
+    assert (tmp_path / "sentiment_totals.json").exists()
+
+
+def test_sentiment_length_buckets_usage_errors(fixture_csv, tmp_path, capsys):
+    import pytest
+
+    # Buckets with a non-encoder family fail at parse time, not mid-run.
+    for argv in (
+        ["sentiment", str(fixture_csv), "--mock", "--length-buckets", "32"],
+        ["sentiment", str(fixture_csv), "--model", "llama3",
+         "--length-buckets", "auto"],
+        ["sentiment", str(fixture_csv), "--model", "distilbert-tiny",
+         "--length-buckets", "0,32"],
+        ["sweep", str(fixture_csv), "--devices", "-2"],
+    ):
+        with pytest.raises(SystemExit) as exc:
+            main(argv)
+        assert exc.value.code == 2
+        assert "error" in capsys.readouterr().err

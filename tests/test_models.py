@@ -148,6 +148,48 @@ class TestDistilBertLengthBuckets:
                 length_buckets=(128,),
             )
 
+    def test_derive_length_buckets(self):
+        from music_analyst_tpu.models.distilbert import derive_length_buckets
+
+        # Cap-dominated corpus (the headline shape): no bucket is worth a
+        # compiled program, flat path stays.
+        assert derive_length_buckets(np.full(100, 128), 128) == ()
+        # Short-skewed corpus: real buckets come back, ascending.
+        short = np.concatenate([np.full(40, 20), np.full(40, 50),
+                                np.full(20, 128)])
+        assert derive_length_buckets(short, 128) == (32, 64)
+        # Rows of a dropped bucket roll upward into the next kept one.
+        mixed = np.concatenate([np.full(3, 10), np.full(47, 30),
+                                np.full(50, 128)])
+        assert derive_length_buckets(mixed, 128) == (32,)
+        # Degenerate inputs.
+        assert derive_length_buckets(np.array([]), 128) == ()
+        assert derive_length_buckets(np.full(10, 4), 16) == ()
+
+    def test_auto_buckets_resolve_on_first_batch(self):
+        clf = DistilBertClassifier(
+            config=DistilBertConfig.tiny(), max_len=64, length_buckets="auto"
+        )
+        assert clf.length_buckets == "auto"
+        labels = clf.classify_batch(["hi there you", "la la love"] * 20)
+        assert len(labels) == 40
+        # All-short corpus → a real short bucket was derived (plus the
+        # implicit max_len bucket _check_buckets appends).
+        assert isinstance(clf.length_buckets, tuple)
+        assert clf.length_buckets[0] < 64
+        # Second batch reuses the resolved buckets (no re-derivation).
+        resolved = clf.length_buckets
+        clf.classify_batch(["longer lyric " + "word " * 60])
+        assert clf.length_buckets is resolved
+
+    def test_auto_buckets_stay_flat_on_capped_corpus(self):
+        clf = DistilBertClassifier(
+            config=DistilBertConfig.tiny(), max_len=64, length_buckets="auto"
+        )
+        long_texts = ["word " * 100] * 8
+        clf.classify_batch(long_texts)
+        assert clf.length_buckets is None
+
 
 class TestLlama:
     @pytest.fixture(scope="class")

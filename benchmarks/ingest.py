@@ -51,8 +51,26 @@ def run() -> dict:
         else:
             native_row = {"error": native.unavailable_reason()}
 
+        # Multi-controller partitioning cost: the per-process record-range
+        # scan (native/ingest.cpp:man_record_ranges) vs the whole-file
+        # Python record parse it replaced in parallel/distributed.py.
+        if native_available:
+            native.record_range(path, 8, 0)  # warm
+            start = time.perf_counter()
+            native.record_range(path, 8, 3)
+            native_row["record_range_seconds"] = round(
+                time.perf_counter() - start, 4
+            )
+
         with open(path, "rb") as fh:
             data = fh.read()
+
+        from music_analyst_tpu.data.csv_io import iter_csv_records_exact
+
+        start = time.perf_counter()
+        for _ in iter_csv_records_exact(data[: len(data) // 20]):
+            pass
+        python_scan_s = (time.perf_counter() - start) * 20  # extrapolated
         start = time.perf_counter()
         ingest_python(data, limit=oracle_songs)
         python_s = time.perf_counter() - start
@@ -68,6 +86,9 @@ def run() -> dict:
             "seconds": round(python_s, 3),
             "songs_per_s": round(python_songs_per_s, 1),
         },
+        # Whole-file pure-Python record scan (the old partitioning cost),
+        # extrapolated from a 1/20 sample; compare record_range_seconds.
+        "python_record_scan_seconds_est": round(python_scan_s, 3),
     }
     if native_available and "songs_per_s" in native_row:
         out["native_over_python"] = round(

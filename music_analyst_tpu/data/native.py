@@ -113,6 +113,14 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.c_char_p,  # text header label
         ctypes.c_int,     # num_threads
     ]
+    lib.man_record_ranges.restype = ctypes.c_longlong
+    lib.man_record_ranges.argtypes = [
+        ctypes.c_char_p,  # dataset path
+        ctypes.c_int,     # n_procs
+        ctypes.c_int,     # p
+        ctypes.c_int,     # num_threads
+        ctypes.c_void_p,  # out int64[3]: header_end, begin, end
+    ]
     lib.man_hash_tokenize_batch.argtypes = [
         ctypes.c_char_p,      # blob
         ctypes.c_void_p,      # offsets int64[n+1]
@@ -199,6 +207,29 @@ def split_columns_native(
     if rc != 1:
         raise RuntimeError(f"native column split failed for {dataset_path}")
     return True
+
+
+def record_range(
+    path: str, n_procs: int, p: int, num_threads: int = 0
+) -> tuple:
+    """Process ``p``'s record-exact slice of the dataset's data records.
+
+    Returns ``(header_end, begin, end, n_records)`` byte offsets: the
+    header record is ``[0, header_end)`` and the slice ``[begin, end)``.
+    Runs the C++ parallel boundary scan — memory-bandwidth work instead of
+    the per-byte Python parse it replaces.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_load_error}")
+    out = (ctypes.c_longlong * 3)()
+    n = lib.man_record_ranges(
+        path.encode("utf-8"), ctypes.c_int(n_procs), ctypes.c_int(p),
+        ctypes.c_int(num_threads), out,
+    )
+    if n < 0:
+        raise RuntimeError(f"native record scan failed to read {path!r}")
+    return int(out[0]), int(out[1]), int(out[2]), int(n)
 
 
 def ingest_native(

@@ -381,12 +381,16 @@ class DistilBertClassifier(ClassifierBackend):
         :meth:`collect`.
         """
         token_ids, lengths = self.tokenizer.encode_batch(texts, self.max_len)
-        if self.length_buckets == "auto":
-            # First batch is the sample: at production batch sizes (4-8k
-            # rows) its length distribution is the corpus's.
+        if self.length_buckets == "auto" and lengths.size:
+            # First non-empty batch is the sample: at production batch
+            # sizes (4-8k rows) its length distribution is the corpus's.
+            # (An empty batch leaves "auto" pending rather than silently
+            # resolving to the flat path forever.)
             self.length_buckets = self._check_buckets(
                 derive_length_buckets(lengths, self.max_len), self.max_len
             )
+        if self.length_buckets == "auto":
+            return texts, []
         if self.length_buckets is None:
             return texts, [(None, *self._dispatch(token_ids, lengths))]
         parts = []

@@ -33,21 +33,51 @@ from music_analyst_tpu.data.ingest import IngestResult, ingest_dataset
 from music_analyst_tpu.parallel import multihost
 
 
-def _my_record_range(data: bytes) -> Tuple[bytes, int]:
+def _my_record_range(dataset_path: str) -> Tuple[bytes, int]:
     """This process's contiguous slice of the dataset's data records.
 
     Returns a reconstructed mini-dataset (header + owned records — records
     keep their terminator bytes, so concatenation is byte-faithful) plus
     the number of owned records.  Contiguous ranges, like the reference's
     per-rank byte slices, but record-exact.
+
+    Partitioning runs the native parallel boundary scan
+    (``native/ingest.cpp:man_record_ranges``) so each process pays
+    O(file/threads) memory-bandwidth work and reads only its own bytes —
+    not the whole-file per-byte Python parse the fallback below does.
+    The two paths may split blank/``\\r\\n`` filler records differently,
+    but every data record lands in exactly one slice either way, which is
+    all the collective merge needs.
     """
+    from music_analyst_tpu.data import native
+
+    n_procs = multihost.process_count()
+    p = multihost.process_index()
+    use_native = native.available()
+    if n_procs > 1:
+        # The two partitioners may split blank/\r\n filler records
+        # differently, so ALL processes must use the same one — a mixed
+        # run (the .so built on one host, failed on another) would let a
+        # record land in two slices or none.  all_agree is a collective:
+        # every process calls it, whatever its local availability.
+        agreed = multihost.all_agree(use_native)
+        use_native = use_native and agreed
+    if use_native:
+        header_end, begin, end, n = native.record_range(
+            dataset_path, n_procs, p
+        )
+        with open(dataset_path, "rb") as fh:
+            header = fh.read(header_end)
+            fh.seek(begin)
+            body = fh.read(end - begin)
+        return (header + body if header else b""), n
+    with open(dataset_path, "rb") as fh:
+        data = fh.read()
     records = list(iter_csv_records_exact(data))
     if not records:
         return b"", 0
     header, body = records[0], records[1:]
-    n_procs = multihost.process_count()
     share = -(-len(body) // n_procs) if body else 0
-    p = multihost.process_index()
     mine = body[p * share : (p + 1) * share]
     return header + b"".join(mine), len(mine)
 
@@ -117,11 +147,15 @@ def distributed_wordcount(
     Every process returns the totals; only the coordinator writes
     ``word_counts.csv``/``top_artists.csv`` (byte-identical to a
     single-process run over the same dataset — asserted by
-    ``tests/test_multiprocess.py``).
+    ``tests/test_multiprocess.py``) plus ``performance_metrics.json``
+    whose min/avg/max spread comes from each process's own measured
+    compute time — the collective analogue of the reference's six
+    ``MPI_Reduce`` timing calls (``src/parallel_spotify.c:1077-1082``).
     """
-    with open(dataset_path, "rb") as fh:
-        data = fh.read()
-    my_slice, _ = _my_record_range(data)
+    import time
+
+    t_start = time.perf_counter()
+    my_slice, _ = _my_record_range(dataset_path)
     # Each process runs the full multithreaded C++ ingest on its slice
     # (written to a scratch file — the native scanner is file-based);
     # the pure-Python oracle is the fallback, as everywhere else.
@@ -154,12 +188,32 @@ def distributed_wordcount(
         np.asarray([corpus.song_count, corpus.token_count], dtype=np.int64)
     )
 
+    # Per-process compute time: partition + ingest + vocab merge + count
+    # psums, measured by each process's own clock, then allgathered so the
+    # coordinator sees the real spread — the reference's MPI_Reduce
+    # min/avg/max over per-rank timings (src/parallel_spotify.c:1077-1082).
+    my_compute = time.perf_counter() - t_start
+    per_process = [
+        float(json.loads(payload.decode("utf-8")))
+        for payload in multihost.allgather_bytes(
+            json.dumps(my_compute).encode("utf-8")
+        )
+    ]
+    # Timestamp AFTER the allgather: the coordinator's wait for slower
+    # processes is skew, not export work, and must not inflate total_time.
+    t_gathered = time.perf_counter()
+
     result = {
         "processes": multihost.process_count(),
         "total_songs": int(totals[0]),
         "total_words": int(totals[1]),
     }
     if multihost.is_coordinator():
+        from music_analyst_tpu.metrics.perf import (
+            TimeStats,
+            write_performance_metrics,
+        )
+
         os.makedirs(output_dir, exist_ok=True)
         word_entries = sort_count_entries(
             (tok, int(n))
@@ -177,6 +231,28 @@ def distributed_wordcount(
         write_count_csv(
             os.path.join(output_dir, "top_artists.csv"), "artist",
             artist_entries,
+        )
+        export_seconds = time.perf_counter() - t_gathered
+        write_performance_metrics(
+            os.path.join(output_dir, "performance_metrics.json"),
+            processes=multihost.process_count(),
+            total_songs=result["total_songs"],
+            total_words=result["total_words"],
+            compute_time=TimeStats.from_samples(per_process),
+            # total = own compute + the coordinator's aggregation/export
+            # tail every process waits out at the final barrier (reference
+            # semantics: compute + aggregation).
+            total_time=TimeStats.from_samples(
+                [c + export_seconds for c in per_process]
+            ),
+            per_chip=[
+                {
+                    "process": i,
+                    "compute_seconds": round(seconds, 9),
+                }
+                for i, seconds in enumerate(per_process)
+            ],
+            device_platform="multi-controller",
         )
     multihost.barrier("distributed_wordcount_export")
     return result

@@ -182,6 +182,16 @@ class TestDistilBertLengthBuckets:
         clf.classify_batch(["longer lyric " + "word " * 60])
         assert clf.length_buckets is resolved
 
+    def test_auto_buckets_pend_through_empty_batches(self):
+        """An empty first batch must not resolve auto to the flat path."""
+        clf = DistilBertClassifier(
+            config=DistilBertConfig.tiny(), max_len=64, length_buckets="auto"
+        )
+        assert clf.classify_batch([]) == []
+        assert clf.length_buckets == "auto"  # still pending
+        clf.classify_batch(["short words"] * 4)
+        assert isinstance(clf.length_buckets, tuple)
+
     def test_auto_buckets_stay_flat_on_capped_corpus(self):
         clf = DistilBertClassifier(
             config=DistilBertConfig.tiny(), max_len=64, length_buckets="auto"

@@ -149,3 +149,24 @@ def test_two_process_wordcount_matches_single_process(tmp_path):
         ),
     )
     assert (out_dir / "top_artists.csv").read_bytes() == expect_artists.read_bytes()
+
+    # The coordinator emits the multi-controller performance_metrics.json
+    # (reference: per-rank MPI_Reduce timing stats) with one genuinely
+    # measured sample per process.
+    import json
+
+    metrics = json.loads((out_dir / "performance_metrics.json").read_text())
+    assert metrics["processes"] == 2
+    assert metrics["total_songs"] == corpus.song_count
+    per_proc = metrics["per_chip"]
+    assert [entry["process"] for entry in per_proc] == [0, 1]
+    samples = [entry["compute_seconds"] for entry in per_proc]
+    assert all(s > 0 for s in samples)
+    # Independent clocks: two processes never measure the same nanosecond.
+    assert samples[0] != samples[1]
+    # compute_time rounds to 6 decimals, samples keep 9.
+    assert abs(metrics["compute_time"]["min_seconds"] - min(samples)) < 1e-5
+    assert abs(metrics["compute_time"]["max_seconds"] - max(samples)) < 1e-5
+    assert metrics["total_time"]["avg_seconds"] >= (
+        metrics["compute_time"]["avg_seconds"]
+    )

@@ -344,10 +344,9 @@ class DistilBertClassifier(ClassifierBackend):
     def _round_rows(n: int) -> int:
         """Next power of two (≥16): bounds the number of compiled batch
         shapes per bucket while keeping row padding ≤ 2×."""
-        size = 16
-        while size < n:
-            size <<= 1
-        return size
+        from music_analyst_tpu.utils.shapes import round_pow2
+
+        return round_pow2(n, 16)
 
     def _pad_batch(self, batch: np.ndarray, lengths: np.ndarray):
         """Pad the row count so the batch splits evenly over the dp axis."""

@@ -556,12 +556,31 @@ class LlamaZeroShotClassifier(ClassifierBackend):
             )
         return cls(config=config, checkpoint_path=ckpt, **kwargs)
 
+    def _trim_prompt_pad(self, ids, lens):
+        """Trim tokenizer padding to the smallest power-of-two width (floor
+        64) that covers the batch's longest prompt, capped at
+        ``max_prompt_len``.
+
+        The decoder analogue of the encoder's length buckets: a
+        short-lyric batch previously paid full ``max_prompt_len`` (1024)
+        prefill FLOPs per row.  Rounding to powers of two keeps the
+        compiled-shape set O(log max_prompt_len); no content is cut
+        (width ≥ lens.max()), and padding columns are masked out of
+        attention either way, so labels/generations are unchanged.
+        """
+        from music_analyst_tpu.utils.shapes import round_pow2
+
+        longest = int(lens.max()) if len(lens) else 1
+        width = min(round_pow2(longest, 64), self.max_prompt_len)
+        return ids[:, :width], lens
+
     def _encode_prompts(self, texts: Sequence[str]):
         prompts = [
             PROMPT_TEMPLATE.format(lyrics=t.strip()[:LYRICS_TRUNCATION])
             for t in texts
         ]
-        return self.tokenizer.encode_batch(prompts, self.max_prompt_len)
+        ids, lens = self.tokenizer.encode_batch(prompts, self.max_prompt_len)
+        return self._trim_prompt_pad(ids, lens)
 
     def classify_batch(self, texts: Sequence[str]) -> List[str]:
         if self.decode_mode == "generate":
@@ -629,6 +648,7 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         API parity and as the differential oracle).
         """
         ids, lens = self.tokenizer.encode_batch(prompts, self.max_prompt_len)
+        ids, lens = self._trim_prompt_pad(ids, lens)
         tokens = np.asarray(
             self._generate_scan(
                 self.params, jnp.asarray(ids), jnp.asarray(lens),

@@ -31,6 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from music_analyst_tpu.utils.shapes import round_pow2
+
 PAD_ID = -1
 
 
@@ -59,13 +61,8 @@ def shard_pad(values: np.ndarray, shards: int, pad_value: int) -> np.ndarray:
     return out
 
 
-def _bucket(n: int, floor: int) -> int:
-    """Round up to a power of two (≥ ``floor``) so jit shapes are stable
-    across datasets and the compilation cache keeps hitting."""
-    size = floor
-    while size < n:
-        size <<= 1
-    return size
+# Shared power-of-two shape policy (utils/shapes.py).
+_bucket = round_pow2
 
 
 def _bucket_linear(n: int, step: int) -> int:

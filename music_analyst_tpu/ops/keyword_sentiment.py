@@ -123,10 +123,9 @@ def score_texts(
     """
     encoded = [t.strip().encode("utf-8", errors="replace") for t in texts]
     max_bytes = max((len(d) for d in encoded), default=1)
-    bucket = 512
-    while bucket < min(max_bytes, length):
-        bucket <<= 1
-    bucket = min(bucket, length)
+    from music_analyst_tpu.utils.shapes import round_pow2
+
+    bucket = min(round_pow2(min(max_bytes, length), 512), length)
     batch = np.zeros((len(encoded), bucket), dtype=np.uint8)
     overflow: List[int] = []
     for i, data in enumerate(encoded):

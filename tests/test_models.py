@@ -371,3 +371,65 @@ def test_generation_decode_mode():
     assert all(l in ("Positive", "Neutral", "Negative") for l in labels)
     singles = [clf.classify_by_generation("some lyrics")]
     assert labels[0] == singles[0]  # batch ≡ single-song reference path
+
+
+class TestLlamaPromptTrimming:
+    """Prefill pads to a power-of-two over the batch's longest prompt,
+    not to max_prompt_len (the decoder analogue of length buckets).
+
+    The equality tests compare programs compiled at different widths.
+    Masked padding contributes exact zeros, but XLA may reassociate the
+    non-zero accumulations differently per shape, so equality is a
+    last-ulp assumption: exact on the CI platform (CPU, fixed seed,
+    float32 config), not a cross-platform guarantee.  A flake here on new
+    hardware means a near-tied argmax, not a trimming bug.
+    """
+
+    def _clf(self, **kw):
+        from music_analyst_tpu.models.llama import (
+            LlamaConfig,
+            LlamaZeroShotClassifier,
+        )
+
+        cfg = LlamaConfig(
+            vocab_size=300, dim=32, n_layers=1, n_heads=4, n_kv_heads=2,
+            hidden_dim=64, rope_theta=1e4, max_seq_len=1024, dtype="float32",
+        )
+        return LlamaZeroShotClassifier(
+            config=cfg, max_prompt_len=512, **kw
+        )
+
+    def test_short_batch_scores_at_trimmed_width(self):
+        clf = self._clf()
+        seen = []
+        real = clf._score_labels
+        clf._score_labels = lambda p, ids, lens, li, ll: (
+            seen.append(ids.shape), real(p, ids, lens, li, ll)
+        )[1]
+        clf.classify_batch(["la la", "short one"])
+        assert seen and seen[0][1] < 512
+        # width is a power of two >= the longest prompt
+        assert seen[0][1] & (seen[0][1] - 1) == 0
+
+    def test_trimming_preserves_labels(self):
+        clf = self._clf()
+        texts = ["short", "mid length lyric with several words " * 2,
+                 "long " + "word " * 150, ""]
+        trimmed = clf.classify_batch(texts)
+        clf._trim_prompt_pad = lambda ids, lens: (ids, lens)  # disable
+        flat = clf.classify_batch(texts)
+        assert trimmed == flat
+
+    def test_trimming_preserves_generations(self):
+        clf = self._clf()
+        prompts = ["say something nice", "la"]
+        trimmed = clf.generate_batch(prompts, max_new_tokens=8)
+        clf._trim_prompt_pad = lambda ids, lens: (ids, lens)
+        flat = clf.generate_batch(prompts, max_new_tokens=8)
+        assert trimmed == flat
+
+    def test_long_prompt_not_cut(self):
+        clf = self._clf()
+        ids, lens = clf._encode_prompts(["word " * 600])  # > 512 tokens
+        assert ids.shape[1] == 512
+        assert int(lens[0]) == 512

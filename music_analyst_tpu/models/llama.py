@@ -69,6 +69,10 @@ class LlamaConfig:
     # expert axis shards over the ``ep`` mesh axis (models/moe.py).
     n_experts: int = 0
     moe_top_k: int = 2
+    # "sparse" = capacity-bounded token-choice dispatch (k*cf FLOPs/token);
+    # "dense" = all-experts oracle (E× FLOPs).  See models/moe.py.
+    moe_dispatch: str = "sparse"
+    moe_capacity_factor: float = 1.25
     # "flash" uses the Pallas blocked-attention kernel on the no-cache
     # (prefill/training) path; seq len must divide its block size.
     attn_impl: str = "dense"
@@ -148,7 +152,9 @@ class LlamaBlock(nn.Module):
                 )
             ffn = MoESwiGLU(
                 cfg.n_experts, cfg.hidden_dim, top_k=cfg.moe_top_k,
-                dtype=dtype, name="feed_forward_moe",
+                dtype=dtype, dispatch=cfg.moe_dispatch,
+                capacity_factor=cfg.moe_capacity_factor,
+                name="feed_forward_moe",
             )
         else:
             ffn = SwiGLU(cfg.hidden_dim, dtype=dtype, quant=cfg.quant,

@@ -8,10 +8,13 @@ so int8 is the remaining large FLOP lever.  This op quantizes on the fly:
 * weights: symmetric per-output-channel, ``s_w[c] = max|w[:,c]| / 127`` —
   computed inside the jitted forward from the ordinary float params, so
   the param tree, checkpoint loaders, and sharding rules are untouched;
-* activations: symmetric per-tensor dynamic, ``s_x = max|x| / 127`` per
-  call (one cheap reduction);
-* accumulation in int32 on the MXU, dequant ``acc · s_x · s_w[c]`` fused
-  into the epilogue by XLA.
+* activations: symmetric per-token (row-wise) dynamic,
+  ``s_x[t] = max|x[t,:]| / 127`` — one outlier token costs only its own
+  row's resolution, not the whole batch's (the per-tensor variant loses
+  ~all precision on every other row once one activation spikes;
+  ``tests/test_quant.py::test_outlier_token_does_not_poison_batch``);
+* accumulation in int32 on the MXU, dequant ``acc · s_x[t] · s_w[c]``
+  fused into the epilogue by XLA.
 
 Accuracy contract: quantization error is bounded by the symmetric-int8
 resolution (~0.8% of the dynamic range per operand); the classifier's
@@ -39,8 +42,8 @@ def quant_matmul(x: jax.Array, w: jax.Array) -> jax.Array:
     """
     x32 = x.astype(jnp.float32)
     w32 = w.astype(jnp.float32)
-    s_x = _symmetric_scale(x32, axis=None, keepdims=False)
-    s_w = _symmetric_scale(w32, axis=0)  # [1, N]
+    s_x = _symmetric_scale(x32, axis=-1)  # [..., 1] per token
+    s_w = _symmetric_scale(w32, axis=0)   # [1, N] per channel
     qx = jnp.round(x32 / s_x).astype(jnp.int8)
     qw = jnp.round(w32 / s_w).astype(jnp.int8)
     acc = jax.lax.dot_general(
@@ -48,7 +51,7 @@ def quant_matmul(x: jax.Array, w: jax.Array) -> jax.Array:
         (((qx.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32,
     )
-    return acc.astype(jnp.float32) * (s_x * s_w.reshape(1, -1))
+    return acc.astype(jnp.float32) * s_x * s_w.reshape(1, -1)
 
 
 def quant_dense_axis_last(x, kernel, bias=None, out_dtype=None):

@@ -74,9 +74,16 @@ def run() -> dict:
     # the sub-measurement raises max_prompt_len to 4× so the trimmed width
     # genuinely sits below the cap (at the suite's own cap the two paths
     # would compile the identical program and measure nothing).
-    trim_cap = max_prompt * 4
+    # 8× under smoke (the template alone is ~250 byte-tokens and smoke's
+    # cap is 64 — a 4× raise would still round up to the cap and compile
+    # the identical program for both paths, measuring nothing).  The flat
+    # path's KV cache grows with trim_cap, so the sub-measurement runs a
+    # quarter batch to stay inside one chip's HBM (KV at B=64, S=1024 is
+    # ~4.3 GB for the 1B proxy; B=256 would be ~17 GB).
+    trim_cap = max_prompt * (8 if smoke() else 4)
+    trim_batch = max(8, batch // 4)
     clf.max_prompt_len = trim_cap
-    short_texts = [f"lyric {i}: love and rain" for i in range(batch)]
+    short_texts = [f"lyric {i}: love and rain" for i in range(trim_batch)]
     # Width of the path actually timed: full template + batch max length.
     trim_width = clf._encode_prompts(short_texts)[0].shape[1]
     trimmed_labels = clf.classify_batch(short_texts)  # compile
@@ -84,6 +91,7 @@ def run() -> dict:
     clf._trim_prompt_pad = lambda ids, lens: (ids, lens)  # disable
     flat_labels = clf.classify_batch(short_texts)  # compile flat shape
     flat_s, _ = timed(lambda: clf.classify_batch(short_texts) or 0, repeats=2)
+    del clf._trim_prompt_pad  # restore the class method
     clf.max_prompt_len = max_prompt
 
     return {
@@ -98,9 +106,10 @@ def run() -> dict:
         "songs_per_s": round(songs_per_s, 1),
         "prefill_trim": {
             "max_prompt_len": trim_cap,
+            "batch": trim_batch,
             "short_batch_width": trim_width,
-            "trimmed_songs_per_s": round(batch / trim_s, 1),
-            "flat_songs_per_s": round(batch / flat_s, 1),
+            "trimmed_songs_per_s": round(trim_batch / trim_s, 1),
+            "flat_songs_per_s": round(trim_batch / flat_s, 1),
             "speedup": round(flat_s / trim_s, 2),
             "labels_equal": trimmed_labels == flat_labels,
         },

@@ -174,6 +174,7 @@ class LlamaModel(nn.Module):
         mask: jax.Array,                           # broadcastable [B,H,S,KV]
         caches: Optional[List[KVCache]] = None,
         lengths: Optional[jax.Array] = None,       # [B] — flash path masks
+        last_position: Optional[jax.Array] = None,  # [B] — see below
     ):
         # CONTRACT: with cfg.attn_impl == "flash" (and no caches), the
         # `mask` argument is NOT applied — attention is causal + key-
@@ -194,6 +195,15 @@ class LlamaModel(nn.Module):
             if new_cache is not None:
                 new_caches.append(new_cache)
         x = RMSNorm(name="norm")(x)
+        if last_position is not None:
+            # Gather ONE position per row BEFORE the vocab projection:
+            # prefill callers only consume the last prompt logits, and a
+            # materialized [B, S, vocab] float32 tensor is the largest
+            # array in the whole model (e.g. 33 GB at B=256, S=256,
+            # V=128k — past a v5e's HBM on its own).  Returns [B, 1, V].
+            x = jnp.take_along_axis(
+                x, last_position[:, None, None].astype(jnp.int32), axis=1
+            )
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                           name="lm_head")(x)
         return logits, (new_caches if caches is not None else None)
@@ -407,8 +417,11 @@ class LlamaZeroShotClassifier(ClassifierBackend):
                 ((0, 0), (0, 0), (0, 0), (0, L)),
             )
             caches = init_caches(self.config, B, S + L)
+            # last_position: only the final prompt logits are consumed, so
+            # the [B,S,V] prefill logits are never materialized.
             logits, caches = self.model.apply(
-                {"params": params}, prompt_ids, positions, mask, caches
+                {"params": params}, prompt_ids, positions, mask, caches,
+                last_position=prompt_lens - 1,
             )
             # Force every cache to report the true prompt length so label
             # positions line up even though the buffer was written at 0..S.
@@ -416,9 +429,7 @@ class LlamaZeroShotClassifier(ClassifierBackend):
                 KVCache(c.keys, c.values, jnp.asarray(S, jnp.int32))
                 for c in caches
             ]
-            last_logits = jnp.take_along_axis(
-                logits, (prompt_lens - 1)[:, None, None], axis=1
-            )[:, 0]  # [B, V]
+            last_logits = logits[:, 0]  # [B, V]
 
             def score_one(label_row, label_len):
                 lab = jnp.broadcast_to(label_row[None, :], (B, L))
@@ -494,18 +505,14 @@ class LlamaZeroShotClassifier(ClassifierBackend):
             )
             caches = init_caches(self.config, B, total)
             logits, caches = self.model.apply(
-                {"params": params}, prompt_ids, positions, mask, caches
+                {"params": params}, prompt_ids, positions, mask, caches,
+                last_position=prompt_lens - 1,
             )
             caches = [
                 KVCache(c.keys, c.values, jnp.asarray(S, jnp.int32))
                 for c in caches
             ]
-            first = jnp.argmax(
-                jnp.take_along_axis(
-                    logits, (prompt_lens - 1)[:, None, None], axis=1
-                )[:, 0],
-                axis=-1,
-            )  # [B]
+            first = jnp.argmax(logits[:, 0], axis=-1)  # [B]
             eos = jnp.asarray(self.tokenizer.eos_id, jnp.int32)
 
             def step(carry, t):
@@ -623,13 +630,14 @@ class LlamaZeroShotClassifier(ClassifierBackend):
             ((0, 0), (0, 0), (0, 0), (0, max_new_tokens)),
         )
         logits, caches = self.model.apply(
-            {"params": self.params}, jnp.asarray(ids), positions, mask, caches
+            {"params": self.params}, jnp.asarray(ids), positions, mask, caches,
+            last_position=jnp.asarray(lens, jnp.int32) - 1,
         )
         caches = [
             KVCache(c.keys, c.values, jnp.asarray(int(lens[0]), jnp.int32))
             for c in caches
         ]
-        token = jnp.argmax(logits[:, int(lens[0]) - 1], axis=-1)
+        token = jnp.argmax(logits[:, 0], axis=-1)
         out_tokens = []
         position = jnp.asarray([int(lens[0])], jnp.int32)
         for _ in range(max_new_tokens):

@@ -1,0 +1,38 @@
+#!/bin/sh
+# Capture every suite's chip output into benchmarks/results/.
+#
+# Run on a healthy TPU (the default environment registers the chip; no env
+# overrides needed).  Each suite's stdout is committed verbatim so
+# PERFORMANCE.md numbers stay regenerable; a failed suite leaves its old
+# capture in place rather than truncating it.  The headline bench.py line
+# is captured last (it is also what the round driver records).
+#
+# Usage: sh benchmarks/capture_all.sh [suite ...]   (default: all)
+
+set -u
+cd "$(dirname "$0")/.."
+out_dir=benchmarks/results
+mkdir -p "$out_dir"
+
+suites=${*:-"roofline ingest scaling flash_sweep generation coldstart joint llama_zeroshot sentiment_int8 bucketing"}
+
+for suite in $suites; do
+    echo "=== $suite ===" >&2
+    tmp=$(mktemp)
+    if python bench.py --suite="$suite" >"$tmp" 2>/tmp/capture_${suite}.err; then
+        # Refuse to publish smoke-shape output as a capture.
+        if grep -q '"smoke": true' "$tmp"; then
+            rm -f "$tmp"
+            echo "    REFUSED: smoke mode output (unset MUSICAAL_BENCH_SMOKE)" >&2
+        else
+            mv "$tmp" "$out_dir/$suite.json"
+            echo "    captured -> $out_dir/$suite.json" >&2
+        fi
+    else
+        rm -f "$tmp"
+        echo "    FAILED (see /tmp/capture_${suite}.err)" >&2
+    fi
+done
+
+echo "=== headline ===" >&2
+python bench.py | tee /tmp/headline_capture.json >&2

@@ -87,6 +87,30 @@ def test_randomized_adversarial_parity(tmp_path):
     p = ingest_python(path.read_bytes())
     assert_parity(n, p)
 
+    # The record-range partitioner must stay record-exact on the same
+    # adversarial bytes: slices contiguous, disjoint, reassembling to the
+    # file, with quoted newlines never splitting a record.
+    data = path.read_bytes()
+    for n_procs in (2, 5):
+        slices = [
+            native.record_range(str(path), n_procs, proc)
+            for proc in range(n_procs)
+        ]
+        header_end = slices[0][0]
+        cursor = header_end
+        for he, begin, end, _ in slices:
+            assert he == header_end
+            assert begin == cursor
+            cursor = end
+        assert cursor == len(data)
+        # Per-slice ingest totals sum to the whole-file totals (no record
+        # lost or double-counted at any boundary).
+        total = sum(
+            ingest_python(data[:he] + data[b:e]).song_count
+            for he, b, e, _ in slices
+        )
+        assert total == p.song_count
+
 
 def test_synthetic_parity_and_threads(tmp_path):
     from music_analyst_tpu.data.synthetic import generate_dataset

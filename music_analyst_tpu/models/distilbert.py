@@ -294,11 +294,23 @@ class DistilBertClassifier(ClassifierBackend):
 
         @jax.jit
         def _forward(params, token_ids, lengths):
-            logits = self.model.apply({"params": params}, token_ids, lengths)
+            # ids may arrive int16 (see _wire_dtype) — widen on device.
+            logits = self.model.apply(
+                {"params": params}, token_ids.astype(jnp.int32), lengths
+            )
             probs = jax.nn.softmax(logits, axis=-1)
             return jnp.argmax(logits, axis=-1), jnp.max(probs, axis=-1)
 
         self._forward = _forward
+        # Host→device transfer rides a ~10 MB/s tunnel in this environment
+        # (roofline suite); token ids are the payload, and every BERT-sized
+        # vocab fits int16, halving the bytes on the wire.  Lossless: the
+        # cast back to int32 happens on device inside the jit.
+        # Sized from the TOKENIZER's id range, not the model config: a
+        # supplied vocab.txt (MUSICAAL_BERT_VOCAB) can be larger than the
+        # config vocab, and an int16 wire would silently wrap its ids.
+        wire_vocab = max(self.config.vocab_size, self.tokenizer.vocab_size)
+        self._wire_dtype = np.int16 if wire_vocab <= (1 << 15) else np.int32
 
     @classmethod
     def from_pretrained_or_random(cls, model: str, **kwargs):
@@ -363,6 +375,7 @@ class DistilBertClassifier(ClassifierBackend):
     def _dispatch(self, token_ids: np.ndarray, lengths: np.ndarray):
         """Pad for the dp axis, place, and launch one forward (async)."""
         token_ids, lengths, n = self._pad_batch(token_ids, lengths)
+        token_ids = np.asarray(token_ids, dtype=self._wire_dtype)
         if self._data_sharding is not None:
             token_ids = jax.device_put(token_ids, self._data_sharding)
             lengths = jax.device_put(lengths, self._data_sharding)

@@ -52,6 +52,18 @@ class TestDistilBert:
         assert all(l in SUPPORTED_LABELS for l in labels)
         assert labels[1] == "Neutral"  # empty lyric rule
 
+    def test_int16_wire_ids_lossless(self):
+        """Token ids ship int16 (vocab fits) and widen on device; labels
+        must match a forced-int32 wire exactly."""
+        import numpy as np
+
+        clf = DistilBertClassifier(config=DistilBertConfig.tiny(), max_len=32)
+        assert clf._wire_dtype == np.int16
+        texts = ["la la love", "pain and tears tonight", ""]
+        got = clf.classify_batch(texts)
+        clf._wire_dtype = np.int32
+        assert clf.classify_batch(texts) == got
+
     def test_neutral_threshold_extremes(self):
         clf = DistilBertClassifier(
             config=DistilBertConfig.tiny(), max_len=16, neutral_threshold=1.1

@@ -129,3 +129,25 @@ def test_capacity_ceils_not_truncates():
     assert moe_capacity(64, 2, 4, 1.25) == 40
     assert moe_capacity(0, 2, 4, 1.25) == 1
     assert moe_capacity(16, 2, 4, 4.0) == 32  # lossless >= T*k/E*E
+
+
+def test_sparse_moe_inside_classifier_forward():
+    """Sparse dispatch composes with the real model: zero-shot scoring
+    (vmapped label continuation) and scan generation both run with an
+    MoE FFN, honoring the empty-lyric rule."""
+    from music_analyst_tpu.models.llama import (
+        LlamaConfig,
+        LlamaZeroShotClassifier,
+    )
+
+    cfg = LlamaConfig(
+        vocab_size=300, dim=32, n_layers=1, n_heads=4, n_kv_heads=2,
+        hidden_dim=64, rope_theta=1e4, max_seq_len=256, dtype="float32",
+        n_experts=4, moe_top_k=2,
+    )
+    clf = LlamaZeroShotClassifier(config=cfg, max_prompt_len=128)
+    labels = clf.classify_batch(["love and rain", "", "pain " * 20])
+    assert labels[1] == "Neutral"
+    assert all(l in ("Positive", "Neutral", "Negative") for l in labels)
+    outs = clf.generate_batch(["say hi", "la"], max_new_tokens=4)
+    assert len(outs) == 2

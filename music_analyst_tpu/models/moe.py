@@ -17,6 +17,14 @@ lossless and reproduces the dense path exactly, which is how the
 differential test pins the implementation (``tests/test_moe.py``).
 
 ``dispatch="dense"`` keeps the exact all-experts compute as the oracle.
+
+Sharding semantics under an ``ep`` mesh axis: the expert einsums — where
+~all FLOPs live — partition over ``E`` (weights carry the sharded axis);
+the routing/scatter/gather bookkeeping computes on replicated token
+activations (O(T·(k+D)) elementwise work, no matmuls) and XLA slices the
+buffer per shard at the einsum boundary.  An explicit all-to-all token
+exchange only pays off once tokens themselves are ep-sharded across
+hosts — the multi-host regime ``parallel/distributed.py`` owns.
 """
 
 from __future__ import annotations

@@ -31,6 +31,13 @@ class FakeClock:
         self.now += s
 
 
+def _probe_ok(cmd, clock, took=3.0):
+    """Fake a healthy probe child (``--probe`` settles in seconds)."""
+    clock.advance(took)
+    return subprocess.CompletedProcess(cmd, returncode=0, stdout="1\n",
+                                       stderr="")
+
+
 def _parse_only_line(capsys) -> dict:
     out = capsys.readouterr().out.strip().splitlines()
     assert len(out) == 1, f"expected exactly one stdout line, got {out!r}"
@@ -38,12 +45,14 @@ def _parse_only_line(capsys) -> dict:
 
 
 def test_worst_case_all_attempts_hang_fits_deadline(capsys):
-    """Every attempt times out; the error line lands before the deadline."""
+    """Everything times out; the error line lands before the deadline."""
     clock = FakeClock()
     timeouts = []
+    cmds = []
 
     def hang_run(cmd, capture_output, text, timeout):
         timeouts.append(timeout)
+        cmds.append(cmd)
         clock.advance(timeout)
         raise subprocess.TimeoutExpired(cmd, timeout)
 
@@ -62,6 +71,14 @@ def test_worst_case_all_attempts_hang_fits_deadline(capsys):
     assert len(timeouts) >= 2
     # No single attempt may exceed its cap or the remaining budget.
     assert all(t <= bench.ATTEMPT_CAP_S for t in timeouts)
+    # A dead tunnel is spent on cheap probes (VERDICT r4 #5); only the
+    # tail window that can no longer fit a probe cycle goes to one
+    # last-ditch blind attempt (which would ride out a late recovery).
+    probe_t = [t for t, c in zip(timeouts, cmds) if "--probe" in c]
+    child_t = [t for t, c in zip(timeouts, cmds) if "--child" in c]
+    assert len(probe_t) >= 3
+    assert len(child_t) <= 1
+    assert all(t <= bench.PROBE_HUNG_TIMEOUT_S for t in probe_t)
 
 
 def test_worst_case_slow_failures_fit_deadline(capsys):
@@ -69,6 +86,7 @@ def test_worst_case_slow_failures_fit_deadline(capsys):
     clock = FakeClock()
 
     def slow_fail_run(cmd, capture_output, text, timeout):
+        # Probes and attempts alike fail just under their timeout.
         clock.advance(timeout - 1.0)
         return subprocess.CompletedProcess(
             cmd, returncode=1, stdout="", stderr="RuntimeError: UNAVAILABLE"
@@ -101,6 +119,8 @@ def test_timed_out_child_stdout_is_salvaged(capsys):
                "unit": "songs/sec", "vs_baseline": 0.2}
 
     def hang_after_print(cmd, capture_output, text, timeout):
+        if "--probe" in cmd:
+            return _probe_ok(cmd, clock)
         clock.advance(timeout)
         raise subprocess.TimeoutExpired(
             cmd, timeout, output=json.dumps(payload) + "\n"
@@ -122,6 +142,8 @@ def test_nonzero_exit_after_result_line_is_salvaged(capsys):
                "unit": "songs/sec", "vs_baseline": 0.05}
 
     def crash_after_print(cmd, capture_output, text, timeout):
+        if "--probe" in cmd:
+            return _probe_ok(cmd, clock)
         clock.advance(40.0)
         return subprocess.CompletedProcess(
             cmd, returncode=1,
@@ -135,6 +157,95 @@ def test_nonzero_exit_after_result_line_is_salvaged(capsys):
     )
     assert rc == 0
     assert _parse_only_line(capsys) == payload
+
+
+def test_probe_fail_then_recover_still_measures(capsys):
+    """VERDICT r4 #5: a tunnel that is dead for most of the window must not
+    exhaust the budget — cheap probes keep the attempts in reserve, so a
+    recovery at t≈300 s still gets a full measurement in."""
+    clock = FakeClock()
+    recovery_at = 300.0
+    launches = []
+    payload = {"metric": bench.METRIC, "value": 2500.0,
+               "unit": "songs/sec", "vs_baseline": 1.2}
+
+    def run(cmd, capture_output, text, timeout):
+        launches.append((clock.now, cmd))
+        if "--probe" in cmd:
+            if clock.now < recovery_at:
+                # Dead tunnel: the probe child errors out in seconds.
+                clock.advance(4.0)
+                return subprocess.CompletedProcess(
+                    cmd, returncode=1, stdout="",
+                    stderr="RuntimeError: UNAVAILABLE: axon tunnel",
+                )
+            return _probe_ok(cmd, clock)
+        clock.advance(90.0)  # healthy measurement: compile + sweep
+        return subprocess.CompletedProcess(
+            cmd, returncode=0, stdout=json.dumps(payload) + "\n", stderr=""
+        )
+
+    rc = bench._run_parent(
+        4, bench._DEFAULT_DEADLINE_S,
+        run=run, sleep=clock.advance, clock=clock,
+    )
+    assert rc == 0
+    assert _parse_only_line(capsys) == payload
+    assert clock.now <= bench._DEFAULT_DEADLINE_S - bench.SAFETY_S + 1e-6
+    # No full measurement child before the tunnel recovered…
+    measured_at = [t for t, cmd in launches if "--child" in cmd]
+    assert measured_at and all(t >= recovery_at for t in measured_at)
+    # …and the dead phase was spent on cheap probes only.
+    dead_launches = [cmd for t, cmd in launches if t < recovery_at]
+    assert dead_launches and all("--probe" in c for c in dead_launches)
+
+
+def test_probe_timeout_budget_respects_min_attempt(capsys):
+    """A probe is never given a budget that would eat into the minimum
+    viable attempt window, and hung probes escalate the leash instead of
+    re-SIGKILLing at 35 s (lease-wedge risk, CLAUDE.md)."""
+    clock = FakeClock()
+    probe_timeouts = []
+
+    def run(cmd, capture_output, text, timeout):
+        if "--probe" in cmd:
+            probe_timeouts.append((clock.now, timeout))
+        clock.advance(timeout)
+        raise subprocess.TimeoutExpired(cmd, timeout)
+
+    bench._run_parent(4, 250.0, run=run, sleep=clock.advance, clock=clock)
+    capsys.readouterr()
+    assert probe_timeouts
+    for t, budget in probe_timeouts:
+        assert budget <= bench.PROBE_HUNG_TIMEOUT_S
+        assert budget <= 250.0 - t - bench.SAFETY_S - bench.MIN_ATTEMPT_S + 1e-6
+    # The first probe uses the short leash; later ones (after a kill) may
+    # use the long one.
+    assert probe_timeouts[0][1] <= bench.PROBE_TIMEOUT_S
+
+
+def test_tight_deadline_still_measures_without_probe(capsys):
+    """The minimum deadline that admits a measurement must stay at
+    MIN_ATTEMPT_S + SAFETY_S: a window too small to probe skips the probe
+    rather than forfeiting the attempt."""
+    clock = FakeClock()
+    payload = {"metric": bench.METRIC, "value": 900.0,
+               "unit": "songs/sec", "vs_baseline": 0.5}
+    cmds = []
+
+    def run(cmd, capture_output, text, timeout):
+        cmds.append(cmd)
+        clock.advance(100.0)
+        return subprocess.CompletedProcess(
+            cmd, returncode=0, stdout=json.dumps(payload) + "\n", stderr=""
+        )
+
+    deadline = bench.MIN_ATTEMPT_S + bench.SAFETY_S + 5.0  # < MIN_PROBE_S spare
+    rc = bench._run_parent(4, deadline, run=run, sleep=clock.advance,
+                           clock=clock)
+    assert rc == 0
+    assert _parse_only_line(capsys) == payload
+    assert all("--child" in c for c in cmds)  # no probe fit, none launched
 
 
 def test_malformed_deadline_env_falls_back(monkeypatch):
@@ -151,6 +262,8 @@ def test_success_passes_through(capsys):
                "unit": "songs/sec", "vs_baseline": 0.1}
 
     def ok_run(cmd, capture_output, text, timeout):
+        if "--probe" in cmd:
+            return _probe_ok(cmd, clock)
         clock.advance(30.0)
         return subprocess.CompletedProcess(
             cmd, returncode=0, stdout=json.dumps(payload) + "\n", stderr=""

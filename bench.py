@@ -273,7 +273,12 @@ def _run_parent(
             status, probe_error = _probe_device(
                 run, min(probe_cap, afford_probe)
             )
-            if status != "ok":
+            if status == "ok":
+                # A proven-healthy tunnel drops any escalated leash: if it
+                # dies again later, the short cadence maximizes the probe
+                # cycles left in the window.
+                probe_cap = PROBE_TIMEOUT_S
+            else:
                 last_error = probe_error
                 probe_cap = (
                     PROBE_HUNG_TIMEOUT_S

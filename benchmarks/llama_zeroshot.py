@@ -82,17 +82,30 @@ def run() -> dict:
     # ~4.3 GB for the 1B proxy; B=256 would be ~17 GB).
     trim_cap = max_prompt * (8 if smoke() else 4)
     trim_batch = max(8, batch // 4)
-    clf.max_prompt_len = trim_cap
     short_texts = [f"lyric {i}: love and rain" for i in range(trim_batch)]
-    # Width of the path actually timed: full template + batch max length.
-    trim_width = clf._encode_prompts(short_texts)[0].shape[1]
-    trimmed_labels = clf.classify_batch(short_texts)  # compile
-    trim_s, _ = timed(lambda: clf.classify_batch(short_texts) or 0, repeats=2)
-    clf._trim_prompt_pad = lambda ids, lens: (ids, lens)  # disable
-    flat_labels = clf.classify_batch(short_texts)  # compile flat shape
-    flat_s, _ = timed(lambda: clf.classify_batch(short_texts) or 0, repeats=2)
-    del clf._trim_prompt_pad  # restore the class method
-    clf.max_prompt_len = max_prompt
+    # The sub-measurement mutates the shared classifier (cap raise +
+    # instance-attribute shadowing of _trim_prompt_pad); restore both in
+    # a finally so an exception mid-measurement can't leave `clf`
+    # corrupted for anything run later in the process (r4 advisor
+    # finding).
+    try:
+        clf.max_prompt_len = trim_cap
+        # Width of the path actually timed: full template + batch max
+        # length.
+        trim_width = clf._encode_prompts(short_texts)[0].shape[1]
+        trimmed_labels = clf.classify_batch(short_texts)  # compile
+        trim_s, _ = timed(
+            lambda: clf.classify_batch(short_texts) or 0, repeats=2
+        )
+        clf._trim_prompt_pad = lambda ids, lens: (ids, lens)  # disable
+        flat_labels = clf.classify_batch(short_texts)  # compile flat shape
+        flat_s, _ = timed(
+            lambda: clf.classify_batch(short_texts) or 0, repeats=2
+        )
+    finally:
+        if "_trim_prompt_pad" in vars(clf):
+            del clf._trim_prompt_pad  # restore the class method
+        clf.max_prompt_len = max_prompt
 
     return {
         "suite": "llama_zeroshot",

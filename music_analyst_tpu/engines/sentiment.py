@@ -70,6 +70,21 @@ class ClassifierBackend:
         return handle
 
 
+def _has_buckets(length_buckets) -> bool:
+    """Whether a ``length_buckets`` value actually requests bucketing.
+
+    ``None`` and an empty sequence both mean "unset"; `len(...)` (not
+    truthiness) so numpy arrays work as sequences; strings ("auto" or a
+    mistaken "32,64") count as set and defer to the classifier's own
+    validation for a clear message.  Shared by ``get_backend`` and
+    ``run_sentiment``'s injected-backend guard so the two entry points
+    agree on what "unset" means (r4 advisor finding).
+    """
+    return length_buckets is not None and (
+        isinstance(length_buckets, str) or len(length_buckets) > 0
+    )
+
+
 def get_backend(
     model: str,
     mock: bool = False,
@@ -90,12 +105,7 @@ def get_backend(
     passthrough); ``length_buckets`` is encoder-only and *raises* elsewhere
     (silently running every row at full length would defeat the flag).
     """
-    # `len(...)` (not truthiness) so numpy arrays work as sequences;
-    # strings ("auto" or a mistaken "32,64") defer to the classifier's
-    # own validation for a clear message.
-    has_buckets = length_buckets is not None and (
-        isinstance(length_buckets, str) or len(length_buckets) > 0
-    )
+    has_buckets = _has_buckets(length_buckets)
     if has_buckets and (mock or not model.startswith("distilbert")):
         raise ValueError(
             "length_buckets is an encoder-classifier option; "
@@ -240,7 +250,7 @@ def run_sentiment(
 
         enable_persistent_compilation_cache()
     if backend is not None:
-        if mesh is not None or length_buckets is not None:
+        if mesh is not None or _has_buckets(length_buckets):
             # An injected backend was constructed by the caller; silently
             # dropping construction-time options here would be a lie.
             raise ValueError(

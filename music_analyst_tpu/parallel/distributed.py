@@ -42,9 +42,12 @@ def _my_record_range(dataset_path: str) -> Tuple[bytes, int]:
     per-rank byte slices, but record-exact.
 
     Partitioning runs the native parallel boundary scan
-    (``native/ingest.cpp:man_record_ranges``) so each process pays
-    O(file/threads) memory-bandwidth work and reads only its own bytes —
-    not the whole-file per-byte Python parse the fallback below does.
+    (``native/ingest.cpp:man_record_ranges``): every process still maps
+    the whole file once (the quote-parity scan needs all bytes, so
+    per-process memory stays O(file)), but that pass runs at memory
+    bandwidth across threads, and only this process's slice is then
+    re-read and parsed — unlike the whole-file per-byte Python parse the
+    fallback below does.
     The two paths may split blank/``\\r\\n`` filler records differently,
     but every data record lands in exactly one slice either way, which is
     all the collective merge needs.

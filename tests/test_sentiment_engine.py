@@ -69,6 +69,28 @@ def test_length_buckets_rejected_for_non_encoder(fixture_csv, tmp_path):
         )
 
 
+def test_injected_backend_guard_matches_get_backend_unset(fixture_csv,
+                                                          tmp_path):
+    """run_sentiment's injected-backend guard and get_backend must agree on
+    what an "unset" length_buckets is: an empty sequence means no buckets
+    in both entry points (r4 advisor finding), while a non-empty one still
+    raises alongside an explicit backend."""
+    import pytest
+
+    from music_analyst_tpu.models.mock import MockKeywordClassifier
+
+    result = run_sentiment(
+        str(fixture_csv), backend=MockKeywordClassifier(),
+        output_dir=str(tmp_path), quiet=True, length_buckets=(),
+    )
+    assert sum(result.counts.values()) == len(result.rows) > 0
+    with pytest.raises(ValueError, match="cannot be combined"):
+        run_sentiment(
+            str(fixture_csv), backend=MockKeywordClassifier(),
+            output_dir=str(tmp_path), quiet=True, length_buckets=(16,),
+        )
+
+
 def test_mesh_capability_gate():
     """mesh= must reach only the on-device model families; the keyword
     kernel and the Ollama HTTP passthrough take no mesh kwarg."""

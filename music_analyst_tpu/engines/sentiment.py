@@ -80,9 +80,20 @@ def _has_buckets(length_buckets) -> bool:
     ``run_sentiment``'s injected-backend guard so the two entry points
     agree on what "unset" means (r4 advisor finding).
     """
-    return length_buckets is not None and (
-        isinstance(length_buckets, str) or len(length_buckets) > 0
-    )
+    if length_buckets is None:
+        return False
+    if isinstance(length_buckets, str):
+        return True
+    try:
+        return len(length_buckets) > 0
+    except TypeError:
+        # A scalar (length_buckets=32) is a plausible slip for a
+        # one-bucket list; name the misuse instead of letting a bare
+        # `len(int)` TypeError surface from deep inside either caller.
+        raise TypeError(
+            "length_buckets must be a string ('auto') or a sequence of "
+            f"ints, got {type(length_buckets).__name__}"
+        ) from None
 
 
 def get_backend(

@@ -142,18 +142,15 @@ class LlamaBlock(nn.Module):
         if cfg.n_experts > 0:
             from music_analyst_tpu.models.moe import MoESwiGLU
 
-            if cfg.quant != "none":
-                # Refuse rather than silently quantize only the attention
-                # projections: the expert MLPs are the bulk of MoE FLOPs,
-                # and a mostly-bf16 model labeled "int8" would mislead
-                # every benchmark comparison.
-                raise NotImplementedError(
-                    "quant='int8' is not supported for MoE configs yet"
-                )
+            # quant composes: the expert einsums (the bulk of MoE FLOPs)
+            # run the per-expert int8 batched matmul alongside the
+            # attention projections' int8 path, so an "int8" MoE model is
+            # quantized where the FLOPs actually are.
             ffn = MoESwiGLU(
                 cfg.n_experts, cfg.hidden_dim, top_k=cfg.moe_top_k,
                 dtype=dtype, dispatch=cfg.moe_dispatch,
                 capacity_factor=cfg.moe_capacity_factor,
+                quant=cfg.quant,
                 name="feed_forward_moe",
             )
         else:

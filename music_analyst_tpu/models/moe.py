@@ -62,6 +62,22 @@ class MoESwiGLU(nn.Module):
     # Buffer slots per expert = ceil(T*k/E) * capacity_factor.  1.25 keeps
     # drops rare under mild router imbalance; >= n_experts is lossless.
     capacity_factor: float = 1.25
+    # "int8" routes the expert einsums — where ~all MoE FLOPs live —
+    # through the dynamic per-expert int8 matmul
+    # (``ops/quant.py:quant_batched_matmul``); the router stays f32 (a
+    # [D,E] sliver of the FLOPs, and top-k index flips under quantization
+    # would change *routing*, not just precision).  Same contract as the
+    # dense layers' quant flag: inference-only, default OFF.
+    quant: str = "none"
+
+    def _expert_mm(self, x: jax.Array, w: jax.Array) -> jax.Array:
+        """Batched per-expert matmul ``[E,C,K] @ [E,K,N]`` in self.dtype
+        or via the int8 MXU path."""
+        if self.quant == "int8":
+            from music_analyst_tpu.ops.quant import quant_batched_matmul
+
+            return quant_batched_matmul(x, w).astype(self.dtype)
+        return jnp.einsum("eck,ekn->ecn", x, w.astype(self.dtype))
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -95,6 +111,25 @@ class MoESwiGLU(nn.Module):
             * top_weights[..., None],
             axis=-2,
         )
+        if self.quant == "int8":
+            # Same batched-matmul layout as the sparse path so both
+            # dispatches quantize identically: broadcast the tokens to
+            # every expert ([E,T,D] — the dense oracle already pays E×
+            # FLOPs, the copy is not the cost driver).
+            B, S, D = x.shape
+            T = B * S
+            xb = jnp.broadcast_to(
+                x.reshape(T, D).astype(self.dtype), (E, T, D)
+            )
+            gate = self._expert_mm(xb, gate_w)
+            up = self._expert_mm(xb, up_w)
+            out = self._expert_mm(nn.silu(gate) * up, down_w)  # [E,T,D]
+            out = jnp.einsum(
+                "te,etd->td",
+                combine.reshape(T, E).astype(jnp.float32),
+                out.astype(jnp.float32),
+            ).reshape(B, S, D)
+            return out.astype(x.dtype)
         xc = x.astype(self.dtype)
         gate = jnp.einsum("bsd,edh->besh", xc, gate_w.astype(self.dtype))
         up = jnp.einsum("bsd,edh->besh", xc, up_w.astype(self.dtype))
@@ -134,11 +169,9 @@ class MoESwiGLU(nn.Module):
             xt[flat_token], mode="drop"
         )
 
-        gate = jnp.einsum("ecd,edh->ech", buf, gate_w.astype(self.dtype))
-        up = jnp.einsum("ecd,edh->ech", buf, up_w.astype(self.dtype))
-        out_buf = jnp.einsum(
-            "ech,ehd->ecd", nn.silu(gate) * up, down_w.astype(self.dtype)
-        )                                                      # [E,C,D]
+        gate = self._expert_mm(buf, gate_w)
+        up = self._expert_mm(buf, up_w)
+        out_buf = self._expert_mm(nn.silu(gate) * up, down_w)  # [E,C,D]
 
         gathered = out_buf[flat_expert, jnp.minimum(safe_pos, capacity - 1)]
         contrib = gathered.astype(jnp.float32) * (

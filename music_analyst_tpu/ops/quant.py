@@ -54,6 +54,33 @@ def quant_matmul(x: jax.Array, w: jax.Array) -> jax.Array:
     return acc.astype(jnp.float32) * s_x * s_w.reshape(1, -1)
 
 
+def quant_batched_matmul(x: jax.Array, w: jax.Array) -> jax.Array:
+    """Per-expert ``x[e] @ w[e]``: x ``[E, C, K]``, w ``[E, K, N]`` → f32
+    ``[E, C, N]``.
+
+    The MoE expert einsums (``models/moe.py``) are batched matmuls with a
+    leading expert axis; scales follow the same symmetric scheme as
+    :func:`quant_matmul`, kept **per expert**: activations per ``(e, row)``
+    (one hot expert's buffer rows can't poison another's resolution),
+    weights per ``(e, out-channel)``.  Accumulation is int32 on the MXU
+    with the dequant fused into the epilogue; the expert batch axis maps
+    onto dot_general batch dims, so an ``ep``-sharded weight stack shards
+    the quantized compute identically to the float path.
+    """
+    x32 = x.astype(jnp.float32)
+    w32 = w.astype(jnp.float32)
+    s_x = _symmetric_scale(x32, axis=-1)  # [E, C, 1] per expert-row
+    s_w = _symmetric_scale(w32, axis=1)   # [E, 1, N] per expert-channel
+    qx = jnp.round(x32 / s_x).astype(jnp.int8)
+    qw = jnp.round(w32 / s_w).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        qx, qw,
+        (((2,), (1,)), ((0,), (0,))),     # contract K, batch over E
+        preferred_element_type=jnp.int32,
+    )
+    return acc.astype(jnp.float32) * s_x * s_w
+
+
 def quant_dense_axis_last(x, kernel, bias=None, out_dtype=None):
     """DenseGeneral(axis=-1): x ``[..., K]``, kernel ``[K, *F]`` → ``[..., *F]``."""
     feat_shape = kernel.shape[1:]

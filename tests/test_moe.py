@@ -112,6 +112,53 @@ def test_sparse_flop_scaling():
     ).replace(")", "")
 
 
+def test_int8_sparse_matches_int8_dense_at_lossless_capacity():
+    """Both dispatches quantize per (expert, row), so with no capacity
+    drops the quantized math is identical up to f32 reduction order —
+    int8 must not widen the sparse/dense gap."""
+    x = jax.random.normal(jax.random.key(4), (2, 6, D), jnp.float32)
+    dense, sparse = _pair(
+        {"dispatch": "dense", "quant": "int8"},
+        {"dispatch": "sparse", "quant": "int8",
+         "capacity_factor": float(E)},
+        x,
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense), np.asarray(sparse), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_int8_tracks_float_moe():
+    """Per-expert int8 expert einsums stay inside the symmetric-int8
+    error bound relative to the float module on the same params."""
+    x = jax.random.normal(jax.random.key(5), (2, 8, D), jnp.float32)
+    f32, q = _pair(
+        {"dispatch": "sparse"},
+        {"dispatch": "sparse", "quant": "int8"},
+        x,
+    )
+    f32, q = np.asarray(f32), np.asarray(q)
+    corr = np.corrcoef(f32.ravel(), q.ravel())[0, 1]
+    assert corr > 0.99, corr
+    # Not bit-identical (that would mean the int8 path never ran).
+    assert np.abs(f32 - q).max() > 0
+
+
+def test_int8_param_tree_identical_to_float():
+    """quant is a compute strategy like dispatch: float checkpoints load
+    into the int8 module unchanged."""
+    x = jnp.zeros((1, 4, D), jnp.float32)
+    tree_a = jax.tree_util.tree_structure(
+        MoESwiGLU(E, H, top_k=K).init(jax.random.key(0), x)["params"]
+    )
+    tree_b = jax.tree_util.tree_structure(
+        MoESwiGLU(E, H, top_k=K, quant="int8").init(
+            jax.random.key(0), x
+        )["params"]
+    )
+    assert tree_a == tree_b
+
+
 def test_bad_dispatch_rejected():
     x = jnp.zeros((1, 4, D), jnp.float32)
     moe = MoESwiGLU(E, H, dispatch="typo")

@@ -89,6 +89,15 @@ def test_injected_backend_guard_matches_get_backend_unset(fixture_csv,
             str(fixture_csv), backend=MockKeywordClassifier(),
             output_dir=str(tmp_path), quiet=True, length_buckets=(16,),
         )
+    # A scalar slip gets a clear message at both entry points, not a bare
+    # len(int) TypeError from deep inside.
+    with pytest.raises(TypeError, match="sequence of ints"):
+        run_sentiment(
+            str(fixture_csv), backend=MockKeywordClassifier(),
+            output_dir=str(tmp_path), quiet=True, length_buckets=32,
+        )
+    with pytest.raises(TypeError, match="sequence of ints"):
+        get_backend("distilbert-tiny", length_buckets=32)
 
 
 def test_mesh_capability_gate():

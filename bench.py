@@ -127,8 +127,12 @@ def measure() -> dict:
     # "distilbert-int8" for the dynamic-quant MXU path); the sentiment_int8
     # suite is the A/B that justifies any non-default choice.
     model = os.environ.get("MUSICAAL_BENCH_MODEL", "distilbert")
-    allowed = {"distilbert", "distilbert-int8",
-               "distilbert-tiny", "distilbert-tiny-int8"}
+    allowed = {
+        f"distilbert{size}{quant}{pack}"
+        for size in ("", "-tiny")
+        for quant in ("", "-int8")
+        for pack in ("", "-packed")
+    }
     if model not in allowed:
         # Fail loudly: from_pretrained_or_random ignores unknown base
         # names, and a typo silently measuring the default config would
@@ -137,8 +141,12 @@ def measure() -> dict:
             f"MUSICAAL_BENCH_MODEL must be one of {sorted(allowed)}, "
             f"got {model!r}"
         )
+    packed = model.endswith("-packed")
     clf = DistilBertClassifier.from_pretrained_or_random(
-        model, max_len=128, length_buckets="auto"
+        model, max_len=128,
+        # Packing and bucketing are exclusive right-sizing levers; the
+        # bucketing suite A/Bs them against each other.
+        length_buckets=None if packed else "auto",
     )
     precision = "int8" if clf.config.quant == "int8" else "bf16"
     batch = 8192  # measured best on v5e: ~10% over 4096 (amortizes dispatch)
@@ -170,6 +178,7 @@ def measure() -> dict:
         ),
         "vs_baseline": round(songs_per_sec / (PER_CHIP_TARGET * n_chips), 3),
         "length_buckets": list(clf.length_buckets or ()),
+        "packed": packed,
     }
 
 

@@ -13,7 +13,11 @@ Two corpora bracket the answer:
 
 The auto path (``length_buckets="auto"``) is what's measured — the same
 configuration ``bench.py`` and ``--length-buckets auto`` ship — so the
-captured number is the shipped behavior, not a hand-tuned one.
+captured number is the shipped behavior, not a hand-tuned one.  A third
+column measures sequence *packing* (``packed=True`` — several lyrics per
+row behind a block-diagonal mask, ``models/distilbert.py:pack_segments``):
+buckets and packing are the two exclusive right-sizing levers, and this
+suite is the A/B that decides which one the engine should default to.
 """
 
 from __future__ import annotations
@@ -37,11 +41,13 @@ def _corpus(mean_words: int, n: int, seed: int) -> list:
     return texts
 
 
-def _measure(texts, max_len: int, cfg, buckets, params=None) -> dict:
+def _measure(texts, max_len: int, cfg, buckets, params=None,
+             packed=False) -> dict:
     from music_analyst_tpu.models.distilbert import DistilBertClassifier
 
     clf = DistilBertClassifier(
-        config=cfg, max_len=max_len, seed=0, length_buckets=buckets
+        config=cfg, max_len=max_len, seed=0, length_buckets=buckets,
+        packed=packed,
     )
     if params is not None:
         # Share one param tree across the flat/auto pair: the ~260 MB
@@ -73,15 +79,29 @@ def run() -> dict:
         texts = _corpus(mean_words, batch, seed=7)
         flat = _measure(texts, max_len, cfg, None)
         auto = _measure(texts, max_len, cfg, "auto", params=flat["params"])
+        # Packed batching (SURVEY §7): same right-sizing goal as buckets,
+        # opposite mechanism — fewer, fuller rows instead of narrower
+        # ones.  Same params so the three labels columns are comparable.
+        packed = _measure(
+            texts, max_len, cfg, None, params=flat["params"], packed=True
+        )
         agree = sum(
             a == b for a, b in zip(flat["labels"], auto["labels"])
+        ) / batch
+        agree_packed = sum(
+            a == b for a, b in zip(flat["labels"], packed["labels"])
         ) / batch
         out[name] = {
             "mean_words": mean_words,
             "flat_songs_per_s": flat["songs_per_s"],
             "auto_songs_per_s": auto["songs_per_s"],
+            "packed_songs_per_s": packed["songs_per_s"],
             "auto_buckets": auto["resolved_buckets"],
             "speedup": round(auto["songs_per_s"] / flat["songs_per_s"], 3),
+            "speedup_packed": round(
+                packed["songs_per_s"] / flat["songs_per_s"], 3
+            ),
             "label_agreement": round(agree, 4),
+            "label_agreement_packed": round(agree_packed, 4),
         }
     return out

@@ -1,0 +1,215 @@
+"""Sequence packing for the encoder path (SURVEY §7 "packed batching").
+
+Packing puts several short lyrics into one max_len row behind a
+block-diagonal attention mask with per-segment restarted positions
+(``models/distilbert.py``).  The contract under test: packed
+classification is the SAME function as flat classification — identical
+labels and near-identical confidences on every input — while running
+fewer device rows.  The reference has no analogue (it classifies one song
+per blocking HTTP call, ``scripts/sentiment_classifier.py:144-154``).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from music_analyst_tpu.models.distilbert import (
+    DistilBertClassifier,
+    DistilBertConfig,
+    pack_segments,
+)
+
+TEXTS = [
+    "love and joy in the morning light",
+    "",
+    "rain rain rain sorrow endless grey rain",
+    "la " * 60,                     # max_len-long row (truncates)
+    "short one",
+    "the memory of summer keeps me warm through winter",
+    "word " * 200,                  # way past the cap
+    "dance tonight",
+]
+
+
+def _f32_tiny():
+    # float32 so flat and packed agree to tight tolerance: same math,
+    # different batching, no bf16 rounding noise near the threshold.
+    return dataclasses.replace(DistilBertConfig.tiny(), dtype="float32")
+
+
+# ---------------------------------------------------------------- packer
+
+
+def test_pack_segments_covers_every_input_once():
+    rng = np.random.default_rng(0)
+    lengths = rng.integers(2, 128, size=257)
+    bin_of, slot_of, starts, row_len = pack_segments(lengths, 128)
+    seen = set(zip(bin_of.tolist(), slot_of.tolist()))
+    assert len(seen) == lengths.size  # one (row, slot) per input
+    # Every (row, slot) points at a real start, and the segment spans
+    # [start, start+len) within the occupied prefix.
+    for i, (b, k) in enumerate(zip(bin_of, slot_of)):
+        assert starts[b, k] < 128
+        assert starts[b, k] + lengths[i] <= row_len[b]
+
+
+def test_pack_segments_respects_capacity_and_is_contiguous():
+    rng = np.random.default_rng(1)
+    lengths = rng.integers(2, 64, size=300)
+    bin_of, slot_of, starts, row_len = pack_segments(lengths, 128)
+    assert row_len.max() <= 128
+    #
+
+    # Segments within a row are back to back: sorted starts + lengths
+    # tile the prefix exactly.
+    for b in range(starts.shape[0]):
+        members = np.flatnonzero(bin_of == b)
+        spans = sorted(
+            (int(starts[b, slot_of[i]]), int(lengths[i])) for i in members
+        )
+        offset = 0
+        for start, length in spans:
+            assert start == offset
+            offset += length
+        assert offset == row_len[b]
+
+
+def test_pack_segments_actually_packs():
+    """Uniform short lyrics should land ~capacity/len per row, not 1."""
+    lengths = np.full(64, 16)
+    bin_of, _, starts, _ = pack_segments(lengths, 128)
+    assert starts.shape[0] == 8          # 128//16 = 8 segments per row
+    assert starts.shape[1] == 8
+
+
+def test_pack_segments_rejects_bad_lengths():
+    with pytest.raises(ValueError, match="length > 0"):
+        pack_segments(np.array([4, 0, 8]), 128)
+    with pytest.raises(ValueError, match="capacity"):
+        pack_segments(np.array([4, 200]), 128)
+
+
+def test_pack_segments_empty():
+    bin_of, slot_of, starts, row_len = pack_segments(np.array([], int), 128)
+    assert bin_of.size == slot_of.size == 0
+    assert starts.shape == (0, 0) and row_len.size == 0
+
+
+# ------------------------------------------------------------ classifier
+
+
+def test_packed_labels_match_flat():
+    cfg = _f32_tiny()
+    flat = DistilBertClassifier(config=cfg, max_len=64, seed=3)
+    packed = DistilBertClassifier(config=cfg, max_len=64, seed=3,
+                                  packed=True)
+    packed.params = flat.params
+    assert packed.classify_batch(TEXTS) == flat.classify_batch(TEXTS)
+
+
+def test_packed_confidences_match_flat():
+    """Stronger than labels: the underlying per-lyric confidences agree,
+    so parity isn't an artifact of coarse label binning."""
+    cfg = _f32_tiny()
+    flat = DistilBertClassifier(config=cfg, max_len=64, seed=4)
+    packed = DistilBertClassifier(config=cfg, max_len=64, seed=4,
+                                  packed=True)
+    packed.params = flat.params
+
+    def confidences(clf):
+        texts, parts = clf.submit(TEXTS)
+        conf = np.empty(len(texts))
+        for rows, _, part_conf, n in parts:
+            part_conf = np.asarray(part_conf)
+            if isinstance(rows, tuple):
+                conf[:n] = part_conf[rows[0], rows[1]]
+            else:
+                conf[:] = part_conf[:n]
+        return conf
+
+    np.testing.assert_allclose(
+        confidences(packed), confidences(flat), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_packed_segment_isolation():
+    """A lyric's result must not depend on its row-mates: classify it
+    alone vs packed among neighbors and compare confidences."""
+    cfg = _f32_tiny()
+    clf = DistilBertClassifier(config=cfg, max_len=64, seed=5, packed=True)
+    target = "love and rain and memory"
+    alone = clf.submit([target])
+    crowded = clf.submit([target] + TEXTS)
+
+    def conf_of(handle, index):
+        _, parts = handle
+        (rows, _, part_conf, _) = parts[0]
+        return float(np.asarray(part_conf)[rows[0][index], rows[1][index]])
+
+    np.testing.assert_allclose(
+        conf_of(alone, 0), conf_of(crowded, 0), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_packed_uses_fewer_rows_than_flat():
+    """The point of the lever: short lyrics share rows."""
+    cfg = _f32_tiny()
+    clf = DistilBertClassifier(config=cfg, max_len=64, seed=0, packed=True)
+    texts = ["short lyric here"] * 64
+    _, parts = clf.submit(texts)
+    ((_, classes, _, _),) = parts
+    assert np.asarray(classes).shape[0] < 64
+
+
+def test_packed_suffix_and_guards():
+    clf = DistilBertClassifier.from_pretrained_or_random(
+        "distilbert-tiny-packed", max_len=64
+    )
+    assert clf.packed and clf.config.dim == 64
+    with pytest.raises(ValueError, match="length_buckets"):
+        DistilBertClassifier(
+            config=DistilBertConfig.tiny(), max_len=64, packed=True,
+            length_buckets=(16, 32),
+        )
+    with pytest.raises(ValueError, match="dense"):
+        DistilBertClassifier(
+            config=dataclasses.replace(DistilBertConfig.tiny(),
+                                       attn_impl="flash"),
+            max_len=64, packed=True,
+        )
+
+
+def test_packed_on_dp_mesh():
+    """Packed rows shard over dp like flat rows do."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devices, ("dp",))
+    cfg = _f32_tiny()
+    flat = DistilBertClassifier(config=cfg, max_len=64, seed=6)
+    # Same seed → same params; the mesh only changes placement.
+    packed = DistilBertClassifier(config=cfg, max_len=64, seed=6,
+                                  packed=True, mesh=mesh)
+    assert packed.classify_batch(TEXTS) == flat.classify_batch(TEXTS)
+
+
+def test_packed_engine_end_to_end(tmp_path):
+    """run_sentiment with --model distilbert-tiny-packed produces the
+    full artifact set with one label per row."""
+    from music_analyst_tpu.engines.sentiment import run_sentiment
+
+    csv_path = tmp_path / "songs.csv"
+    csv_path.write_text(
+        "artist,text\n"
+        + "\n".join(f"a{i},\"lyric {i} love\"" for i in range(9))
+        + "\n",
+        encoding="utf-8",
+    )
+    result = run_sentiment(
+        str(csv_path), model="distilbert-tiny-packed",
+        output_dir=str(tmp_path), quiet=True, batch_size=4,
+    )
+    assert sum(result.counts.values()) == len(result.rows) == 9
+    assert (tmp_path / "sentiment_totals.json").exists()

@@ -135,6 +135,25 @@ def _add_split(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--force", action="store_true")
 
 
+def _add_validate(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "validate",
+        help="certify real weights: label agreement vs a transformers "
+             "torch oracle on a dataset slice (engines/validate.py)",
+    )
+    p.add_argument("dataset")
+    p.add_argument("--model", default="distilbert",
+                   help="distilbert[-*] or llama[3*]; the checkpoint comes "
+                        "from MUSICAAL_DISTILBERT_CKPT / MUSICAAL_LLAMA_CKPT")
+    p.add_argument("--limit", type=int, default=64,
+                   help="Rows in the validation slice (0 = whole dataset)")
+    p.add_argument("--output-dir", default=None,
+                   help="Also write weight_validation.json here")
+    p.add_argument("--min-agreement", type=float, default=None,
+                   help="Exit non-zero when agreement falls below this "
+                        "fraction (CI gate)")
+
+
 def _add_sweep(sub: argparse._SubParsersAction) -> None:
     p = sub.add_parser(
         "sweep",
@@ -158,7 +177,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_wordcount_per_song(sub)
     _add_split(sub)
     _add_sweep(sub)
+    _add_validate(sub)
     args = parser.parse_args(argv)
+
+    if args.command == "validate":
+        from music_analyst_tpu.engines.validate import run_validation
+
+        report = run_validation(
+            args.dataset,
+            model=args.model,
+            limit=args.limit,
+            output_dir=args.output_dir,
+        )
+        if (args.min_agreement is not None
+                and report["agreement"] < args.min_agreement):
+            print(
+                f"FAIL: agreement {report['agreement']} < "
+                f"{args.min_agreement}",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
 
     if args.command == "sweep":
         from music_analyst_tpu.engines.sweep import run_sweep

@@ -216,19 +216,13 @@ def init_caches(
     ]
 
 
-def load_hf_torch_checkpoint(params, path: str):
-    """Map an HF ``LlamaForCausalLM`` torch state_dict onto the Flax params.
+def load_torch_state_dict(path: str) -> dict:
+    """Merge a ``pytorch_model.bin``-style file or a directory of shards
+    (``pytorch_model*.bin`` / ``*.pt``) into one raw state dict.
 
-    ``path`` is a ``pytorch_model.bin``-style file or a directory of such
-    shards (``pytorch_model*.bin`` / ``*.pt``).  torch Linear kernels
-    ``[out, in]`` transpose to ``[in, out]``; attention projections reshape
-    to ``[dim, heads, head_dim]``.  The RoPE convention needs no weight
-    permutation: HF's ``rotate_half`` splits the head dim into contiguous
-    halves, exactly as ``layers.apply_rope`` does.
-
-    Replaces nothing in the reference — its large-model path is a remote
-    Ollama server (``scripts/sentiment_classifier.py:85-100``); here the
-    weights become first-class on-device arrays.
+    Shared by the Flax param mapper below and the validation harness's
+    transformers oracle (``engines/validate.py``), so both sides of a
+    label-agreement report read the checkpoint identically.
     """
     import torch
 
@@ -263,6 +257,26 @@ def load_hf_torch_checkpoint(params, path: str):
         raise ValueError(
             f"no tensors found in {path} — not a torch state_dict?"
         )
+    return sd
+
+
+def load_hf_torch_checkpoint(params, path: str):
+    """Map an HF ``LlamaForCausalLM`` torch state_dict onto the Flax params.
+
+    ``path`` is a ``pytorch_model.bin``-style file or a directory of such
+    shards (``pytorch_model*.bin`` / ``*.pt``).  torch Linear kernels
+    ``[out, in]`` transpose to ``[in, out]``; attention projections reshape
+    to ``[dim, heads, head_dim]``.  The RoPE convention needs no weight
+    permutation: HF's ``rotate_half`` splits the head dim into contiguous
+    halves, exactly as ``layers.apply_rope`` does.
+
+    Replaces nothing in the reference — its large-model path is a remote
+    Ollama server (``scripts/sentiment_classifier.py:85-100``); here the
+    weights become first-class on-device arrays.
+    """
+    import torch
+
+    sd = load_torch_state_dict(path)
     # Tolerate both bare-model ("model.layers...") and prefixed keys.
     sd = { (k[len("model."):] if k.startswith("model.") else k): v
            for k, v in sd.items() }

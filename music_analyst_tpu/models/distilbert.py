@@ -489,13 +489,22 @@ class DistilBertClassifier(ClassifierBackend):
             "MUSICAAL_DISTILBERT_CKPT"
         )
         config = kwargs.pop("config", None)
-        if model.endswith("-packed"):
-            model = model[: -len("-packed")]
-            kwargs.setdefault("packed", True)
-        quant = "none"
-        if model.endswith("-int8"):
-            model, quant = model[: -len("-int8")], "int8"
-        if model.endswith("-tiny"):
+        # Suffixes compose in any order (distilbert-tiny-int8-packed ==
+        # distilbert-tiny-packed-int8): strip to fixpoint.
+        quant, tiny = "none", False
+        stripped = True
+        while stripped:
+            stripped = True
+            if model.endswith("-packed"):
+                model = model[: -len("-packed")]
+                kwargs.setdefault("packed", True)
+            elif model.endswith("-int8"):
+                model, quant = model[: -len("-int8")], "int8"
+            elif model.endswith("-tiny"):
+                model, tiny = model[: -len("-tiny")], True
+            else:
+                stripped = False
+        if tiny:
             config = config or DistilBertConfig.tiny()
         if quant != "none":
             config = dataclasses.replace(

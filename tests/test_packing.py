@@ -167,6 +167,15 @@ def test_packed_suffix_and_guards():
         "distilbert-tiny-packed", max_len=64
     )
     assert clf.packed and clf.config.dim == 64
+    # Every right-sizing/quant suffix composes, in any order.
+    for name in ("distilbert-tiny-int8-packed",
+                 "distilbert-tiny-packed-int8"):
+        combo = DistilBertClassifier.from_pretrained_or_random(
+            name, max_len=64
+        )
+        assert combo.packed and combo.config.quant == "int8"
+        assert combo.config.dim == 64, name  # tiny config, any order
+    assert combo.classify_batch(["love and joy", ""])[1] == "Neutral"
     with pytest.raises(ValueError, match="length_buckets"):
         DistilBertClassifier(
             config=DistilBertConfig.tiny(), max_len=64, packed=True,

@@ -20,10 +20,19 @@ mkdir -p "$out_dir"
 # it explicitly from a multi-device host to refresh.
 suites=${*:-"roofline ingest flash_sweep generation coldstart joint llama_zeroshot sentiment_int8 bucketing"}
 
+# Per-suite wall-clock cap: a suite wedged on a half-healthy tunnel must
+# not stall the remaining captures (the auto-capture loop runs this
+# unattended the moment the tunnel recovers).  Default rides the bench
+# deadline + margin so raising MUSICAAL_BENCH_DEADLINE_S never puts this
+# cap in a position to SIGTERM a healthy run mid-compile (lease-wedge
+# risk, CLAUDE.md).
+suite_timeout=${MUSICAAL_CAPTURE_TIMEOUT_S:-$(( ${MUSICAAL_BENCH_DEADLINE_S:-480} + 420 ))}
+
 for suite in $suites; do
     echo "=== $suite ===" >&2
     tmp=$(mktemp)
-    if python bench.py --suite="$suite" >"$tmp" 2>/tmp/capture_${suite}.err; then
+    if timeout "$suite_timeout" \
+        python bench.py --suite="$suite" >"$tmp" 2>/tmp/capture_${suite}.err; then
         # Refuse to publish smoke-shape output as a capture.
         if grep -q '"smoke": true' "$tmp"; then
             rm -f "$tmp"
@@ -39,4 +48,4 @@ for suite in $suites; do
 done
 
 echo "=== headline ===" >&2
-python bench.py | tee /tmp/headline_capture.json >&2
+timeout "$suite_timeout" python bench.py | tee /tmp/headline_capture.json >&2

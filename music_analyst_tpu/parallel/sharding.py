@@ -68,21 +68,26 @@ def partition_specs(params, rules=None):
     )
 
 
+def prune_spec(spec: P, axis_names) -> P:
+    """Drop axes absent from the mesh (so the same rules serve a dp-only
+    mesh, a dp×tp mesh, etc.).  The single definition used by
+    ``shard_params`` and by abstract-lowering tests, so test placement
+    can't silently diverge from production placement."""
+    return P(*(a if a in axis_names else None for a in spec))
+
+
 def shard_params(params, mesh: Mesh, rules=None, drop_unused_axes: bool = True):
     """Place a param tree on ``mesh`` according to the rules.
 
-    Axes named in a rule but absent from the mesh are dropped from the spec
-    (so the same rules serve a dp-only mesh, a dp×tp mesh, etc.).
+    Axes named in a rule but absent from the mesh are dropped from the
+    spec via :func:`prune_spec`.
     """
     axis_names = set(mesh.axis_names)
-
-    def _prune(spec: P) -> P:
-        return P(*(a if a in axis_names else None for a in spec))
 
     def _place(path, leaf):
         spec = spec_for_path(_path_str(path), rules)
         if drop_unused_axes:
-            spec = _prune(spec)
+            spec = prune_spec(spec, axis_names)
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map_with_path(_place, params)

@@ -26,7 +26,10 @@ suites=${*:-"roofline ingest flash_sweep generation coldstart joint llama_zerosh
 # deadline + margin so raising MUSICAAL_BENCH_DEADLINE_S never puts this
 # cap in a position to SIGTERM a healthy run mid-compile (lease-wedge
 # risk, CLAUDE.md).
-suite_timeout=${MUSICAAL_CAPTURE_TIMEOUT_S:-$(( ${MUSICAAL_BENCH_DEADLINE_S:-480} + 420 ))}
+bench_deadline=${MUSICAAL_BENCH_DEADLINE_S:-480}
+bench_deadline=${bench_deadline%%.*}   # bench.py accepts floats; sh arithmetic doesn't
+case "$bench_deadline" in (""|*[!0-9]*) bench_deadline=480 ;; esac
+suite_timeout=${MUSICAAL_CAPTURE_TIMEOUT_S:-$(( bench_deadline + 420 ))}
 
 for suite in $suites; do
     echo "=== $suite ===" >&2

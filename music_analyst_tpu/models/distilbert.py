@@ -64,7 +64,7 @@ class TransformerBlock(nn.Module):
     config: DistilBertConfig
 
     @nn.compact
-    def __call__(self, x, mask, lengths=None):
+    def __call__(self, x, mask, lengths=None, segment_ids=None):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         attn_out = MultiHeadAttention(
@@ -73,7 +73,8 @@ class TransformerBlock(nn.Module):
             quant=cfg.quant,
             name="attention",
         )(x, mask=None if cfg.attn_impl == "flash" else mask,
-          lengths=lengths)
+          lengths=lengths,
+          segment_ids=segment_ids if cfg.attn_impl == "flash" else None)
         x = nn.LayerNorm(name="sa_layer_norm", dtype=dtype)(x + attn_out)
         mlp_out = GeluMLP(cfg.hidden_dim, dtype=dtype, quant=cfg.quant,
                           name="ffn")(x)
@@ -109,28 +110,29 @@ class DistilBertEncoder(nn.Module):
                        name="position_embeddings")(positions)
         x = nn.LayerNorm(name="embed_layer_norm", dtype=dtype)(tok + pos)
         if segment_ids is not None:
-            if cfg.attn_impl == "flash":
-                # The Pallas flash kernel's masking vocabulary is
-                # causal+lengths (ops/flash_attention.py); block-diagonal
-                # segment masks are not expressible in it yet.
-                raise ValueError(
-                    "packed segments require attn_impl='dense' "
-                    "(flash masking is causal/lengths only)"
-                )
-            # Block-diagonal: token pairs attend iff same segment.  Padding
-            # (segment 0) forms its own group, so a fully padded tail (or
-            # row) softmaxes over uniform masked logits — finite fill in
-            # dot_product_attention keeps that NaN-free — and is never
-            # gathered by the head.
-            mask = (segment_ids[:, None, :, None]
-                    == segment_ids[:, None, None, :])
+            # Block-diagonal: token pairs attend iff same segment.  The
+            # dense impl gets a mask array; the flash kernel takes the
+            # segment ids natively (ops/flash_attention.py segment mode).
+            # Padding (segment 0) forms its own group, so a fully padded
+            # tail (or row) either softmaxes over uniform masked logits
+            # (dense — finite fill keeps it NaN-free) or outputs zeros
+            # (flash guarded denominator); it is never gathered by the
+            # head either way.
+            mask = (
+                None if cfg.attn_impl == "flash"
+                else (segment_ids[:, None, :, None]
+                      == segment_ids[:, None, None, :])
+            )
         else:
             mask = padding_mask(lengths, token_ids.shape[1])
         # CONTRACT: with cfg.attn_impl == "flash", attention masking is
-        # derived from `lengths` alone (key padding); the mask array is
-        # only consumed by the dense impl.
+        # derived from `lengths` + optional `segment_ids` (key padding +
+        # block-diagonal); the mask array is only consumed by the dense
+        # impl.
         for i in range(cfg.n_layers):
-            x = TransformerBlock(cfg, name=f"layer_{i}")(x, mask, lengths)
+            x = TransformerBlock(cfg, name=f"layer_{i}")(
+                x, mask, lengths, segment_ids=segment_ids
+            )
         return x
 
 
@@ -369,19 +371,14 @@ class DistilBertClassifier(ClassifierBackend):
         self.max_len = max_len
         self.neutral_threshold = neutral_threshold
         self.packed = bool(packed)
-        if self.packed:
-            if length_buckets:
-                # Packing already right-sizes padding within full-width
-                # rows; composing the two would bucket *rows of several
-                # lyrics* by the wrong lengths.  One lever at a time.
-                raise ValueError(
-                    "packed=True cannot be combined with length_buckets"
-                )
-            if self.config.attn_impl == "flash":
-                raise ValueError(
-                    "packed=True requires attn_impl='dense' (the flash "
-                    "kernel's masks are causal/lengths only)"
-                )
+        if self.packed and length_buckets:
+            # Packing already right-sizes padding within full-width rows;
+            # composing the two would bucket *rows of several lyrics* by
+            # the wrong lengths.  One lever at a time.  (Flash attention
+            # DOES compose: the kernel takes segment ids natively.)
+            raise ValueError(
+                "packed=True cannot be combined with length_buckets"
+            )
         # "auto" defers to the first submitted batch's length distribution
         # (resolved via derive_length_buckets); a sequence is validated now.
         if isinstance(length_buckets, str):

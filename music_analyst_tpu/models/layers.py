@@ -228,6 +228,7 @@ class MultiHeadAttention(nn.Module):
         positions: Optional[jax.Array] = None,
         cache: Optional[KVCache] = None,
         lengths: Optional[jax.Array] = None,
+        segment_ids: Optional[jax.Array] = None,
     ):
         features = x.shape[-1]
         n_kv = self.n_kv_heads or self.n_heads
@@ -277,9 +278,16 @@ class MultiHeadAttention(nn.Module):
                     "set, or use attn_impl='dense' for arbitrary masks"
                 )
             out = flash_attention(
-                q, k, v, lengths=lengths, causal=self.flash_causal
+                q, k, v, lengths=lengths, causal=self.flash_causal,
+                q_segment_ids=segment_ids,
             )
         else:
+            if segment_ids is not None:
+                raise ValueError(
+                    "segment_ids is the flash path's masking vocabulary; "
+                    "dense callers build the block-diagonal mask array "
+                    "themselves (models/distilbert.py)"
+                )
             out = dot_product_attention(q, k, v, mask)
         out = dense_cls(
             features=features,

@@ -19,6 +19,9 @@ Grid ``(B, H, q_blocks, kv_blocks)``; the kv dimension is innermost and
 sequential ("arbitrary"), with the running state in VMEM scratch that
 persists across kv steps.  GQA maps query head ``h`` to kv head
 ``h // group`` in the BlockSpec index map — no ``jnp.repeat`` of K/V.
+Masking vocabulary: ``causal`` (with block skipping), per-row ``lengths``
+(key padding), and per-token ``segment_ids`` (block-diagonal, for packed
+batches) — all composable in one pass.
 """
 
 from __future__ import annotations
@@ -37,18 +40,20 @@ NEG_INF = -1e30
 def _flash_kernel(
     len_ref,  # SMEM [B] — kv valid length per batch row
     off_ref,  # SMEM [2] — (q_offset, kv_offset) global position offsets
-    q_ref,    # VMEM [1, 1, bq, D]
-    k_ref,    # VMEM [1, 1, bkv, D]
-    v_ref,    # VMEM [1, 1, bkv, D]
-    o_ref,    # VMEM [1, 1, bq, D]
-    *rest,    # residuals=True: m_out/l_out [1, 1, bq, 128], then scratch
+    *refs,    # [qseg, kvseg,] q, k, v, o [, m_out, l_out], scratch...
     causal: bool,
     block_q: int,
     block_kv: int,
     kv_blocks: int,
     scale: float,
     residuals: bool,
+    segmented: bool,
 ):
+    if segmented:
+        # VMEM [1, bq] / [1, bkv] — per-token segment ids (block-diagonal
+        # attention for packed batches, models/distilbert.py).
+        qseg_ref, kvseg_ref, *refs = refs
+    q_ref, k_ref, v_ref, o_ref, *rest = refs
     if residuals:
         m_out_ref, l_out_ref, acc_ref, m_ref, l_ref = rest
     else:
@@ -91,6 +96,10 @@ def _flash_kernel(
                 jnp.int32, s.shape, dimension=0
             )
             valid = valid & (kv_pos <= q_pos)
+        if segmented:
+            qs = qseg_ref[0]                                    # [bq]
+            ks = kvseg_ref[0]                                   # [bkv]
+            valid = valid & (qs[:, None] == ks[None, :])
         s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_ref[:, :1]                                  # [bq, 1]
@@ -135,6 +144,8 @@ def _flash_call(
     block_kv: int,
     interpret: bool,
     residuals: bool,
+    q_seg: jax.Array | None = None,   # [B, S] int32 segment ids
+    kv_seg: jax.Array | None = None,  # [B, KV]
 ):
     B, S, H, D = q.shape
     KV = k.shape[1]
@@ -149,6 +160,7 @@ def _flash_call(
     kv_blocks = KV // block_kv
     scale = D ** -0.5
 
+    segmented = q_seg is not None
     kernel = functools.partial(
         _flash_kernel,
         causal=causal,
@@ -157,6 +169,7 @@ def _flash_call(
         kv_blocks=kv_blocks,
         scale=scale,
         residuals=residuals,
+        segmented=segmented,
     )
     qblock_spec = pl.BlockSpec(
         (1, 1, block_q, D),
@@ -183,17 +196,28 @@ def _flash_call(
     else:
         out_shape = jax.ShapeDtypeStruct((B, H, S, D), q.dtype)
         out_specs = qblock_spec
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths, whole [B]
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # offsets [2]
+    ]
+    inputs = [lengths, offsets]
+    if segmented:
+        in_specs.append(pl.BlockSpec(
+            (1, block_q), lambda b, h, qi, ki: (b, qi),
+            memory_space=pltpu.VMEM,
+        ))
+        in_specs.append(pl.BlockSpec(
+            (1, block_kv), lambda b, h, qi, ki: (b, ki),
+            memory_space=pltpu.VMEM,
+        ))
+        inputs += [q_seg, kv_seg]
+    in_specs += [qblock_spec, kvblock_spec, kvblock_spec]
+    inputs += [q, k, v]
     out = pl.pallas_call(
         kernel,
         out_shape=out_shape,
         grid=(B, H, q_blocks, kv_blocks),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths, whole [B]
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # offsets [2]
-            qblock_spec,
-            kvblock_spec,
-            kvblock_spec,
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
@@ -205,7 +229,7 @@ def _flash_call(
                                  "arbitrary"),
         ),
         interpret=interpret,
-    )(lengths, offsets, q, k, v)
+    )(*inputs)
     if residuals:
         o, m, l = out
         # o unnormalized [B,H,S,D] f32; stats collapse their broadcast lane.
@@ -247,6 +271,8 @@ def flash_attention(
     q_offset: jax.Array | int = 0,
     kv_offset: jax.Array | int = 0,
     return_residuals: bool = False,
+    q_segment_ids: jax.Array | None = None,
+    kv_segment_ids: jax.Array | None = None,
 ):
     """Attention over ``[B, S, H, D]`` without materializing logits.
 
@@ -258,6 +284,15 @@ def flash_attention(
     attention's per-device slices) pick a legal block instead of raising.
     Off-TPU the kernel runs in interpreter mode
     so CPU test meshes exercise the same code path.
+
+    ``q_segment_ids`` ``[B, S]`` / ``kv_segment_ids`` ``[B, KV]`` add
+    block-diagonal masking: a query attends only to keys with the SAME
+    segment id (packed batches, ``models/distilbert.py:pack_segments``).
+    ``kv_segment_ids`` defaults to ``q_segment_ids`` for self-attention.
+    Composes with ``lengths``/``causal``; a query whose segment has no
+    valid key outputs zeros (guarded denominator), matching the dense
+    formulation's uniform-over-masked behavior in effect (neither is ever
+    gathered).
 
     ``q_offset``/``kv_offset`` shift the global positions used by the
     causal/length masks — the hook that lets a sequence-parallel caller
@@ -283,7 +318,30 @@ def flash_attention(
     offsets = jnp.stack(
         [jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)]
     )
+    q_seg = kv_seg = None
+    if q_segment_ids is not None:
+        if kv_segment_ids is None:
+            if KV != S:
+                raise ValueError(
+                    "kv_segment_ids is required when KV length differs "
+                    "from the query length"
+                )
+            kv_segment_ids = q_segment_ids
+        if q_segment_ids.shape != (B, S):
+            raise ValueError(
+                f"q_segment_ids must be [B, S]={B, S}, "
+                f"got {q_segment_ids.shape}"
+            )
+        if kv_segment_ids.shape != (B, KV):
+            raise ValueError(
+                f"kv_segment_ids must be [B, KV]={B, KV}, "
+                f"got {kv_segment_ids.shape}"
+            )
+        q_seg = q_segment_ids.astype(jnp.int32)
+        kv_seg = kv_segment_ids.astype(jnp.int32)
+    elif kv_segment_ids is not None:
+        raise ValueError("kv_segment_ids given without q_segment_ids")
     return _flash_call(
         q, k, v, lengths.astype(jnp.int32), offsets, causal, block_q,
-        block_kv, interpret, return_residuals,
+        block_kv, interpret, return_residuals, q_seg=q_seg, kv_seg=kv_seg,
     )

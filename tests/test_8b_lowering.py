@@ -77,9 +77,17 @@ def test_llama3_8b_forward_lowers_sharded(tp):
         )
         return logits
 
+    # The production rules actually produced a TP placement (not a
+    # prune-to-replicated regression): the attention projections carry the
+    # tp axis after pruning for this mesh.
+    q_spec = pruned["layer_0"]["attention"]["q_proj"]["kernel"]
+    assert "tp" in tuple(q_spec), q_spec
+
     lowered = jax.jit(forward).lower(params_sharded, ids, pos)
     hlo = lowered.as_text()
-    # The partitioner really saw the mesh: the module declares 8 devices
-    # and the program carries sharding annotations.
-    assert "sharding" in hlo
-    assert lowered.args_info is not None
+    # The partitioner really saw the 8-way mesh…
+    assert "mhlo.num_partitions = 8" in hlo
+    # …and the tp-sharded params survived into the program: every layer
+    # contributes several {"tp"}-annotated arguments (q/k/v/o + MLP), so
+    # the count must exceed the layer count by a wide margin.
+    assert hlo.count('{"tp"}') >= cfg.n_layers * 4, hlo.count('{"tp"}')

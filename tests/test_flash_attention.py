@@ -107,3 +107,84 @@ def test_fit_block_tile_aligned_divisors_only():
     assert _fit_block(8, 1024) == 8
     with pytest.raises(ValueError, match="pad the sequence"):
         _fit_block(64, 100)              # no 8-aligned divisor exists
+
+
+def _random_segments(key, B, S, max_segs=4):
+    """Contiguous segment ids 1..k per row plus a trailing pad segment 0."""
+    rng = np.random.default_rng(key)
+    seg = np.zeros((B, S), np.int32)
+    for b in range(B):
+        n_segs = rng.integers(1, max_segs + 1)
+        # Random cut points -> contiguous spans, like pack_segments output.
+        cuts = np.sort(rng.choice(np.arange(1, S - 1), size=n_segs - 1,
+                                  replace=False)) if n_segs > 1 else []
+        bounds = [0, *cuts, rng.integers(S // 2, S + 1)]
+        for i in range(len(bounds) - 1):
+            if bounds[i] < bounds[i + 1]:
+                seg[b, bounds[i]:bounds[i + 1]] = i + 1
+    return jnp.asarray(seg)
+
+
+def test_segment_ids_match_dense_block_diagonal():
+    """Segment-masked flash ≡ dense attention under the same block-diagonal
+    mask (the packed-batch contract, models/distilbert.py)."""
+    B, S, H, D = 3, 256, 4, 64
+    q, k, v = _qkv(7, B=B, S=S, H=H, D=D)
+    seg = _random_segments(7, B, S)
+    out = flash_attention(q, k, v, q_segment_ids=seg, block_q=64,
+                          block_kv=64)
+    mask = (seg[:, None, :, None] == seg[:, None, None, :])
+    ref = dot_product_attention(q, k, v, mask=mask)
+    # Compare only rows with a real segment: dense gives fully-masked
+    # (pad-segment-0-vs-itself differs only where both formulations are
+    # garbage-by-contract; segment 0 matches itself in both, so compare
+    # everything).
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_segment_ids_compose_with_lengths_and_gqa():
+    B, S, H, D = 2, 128, 8, 64
+    q, k, v = _qkv(8, B=B, S=S, H=H, D=D, n_kv=2)
+    seg = _random_segments(11, B, S)
+    lengths = jnp.asarray([128, 100], jnp.int32)
+    out = flash_attention(q, k, v, lengths=lengths, q_segment_ids=seg,
+                          block_q=32, block_kv=32)
+    mask = ((seg[:, None, :, None] == seg[:, None, None, :])
+            & padding_mask(lengths, S))
+    ref = dot_product_attention(q, k, v, mask=mask)
+    # Fully-masked queries (pad tokens beyond `lengths` whose segment has
+    # no valid key) are garbage in both formulations (flash: zeros; dense:
+    # uniform-average) — compare only queries with >= 1 valid key.
+    valid_q = np.asarray(mask.sum(axis=-1) > 0)[:, 0]  # [B, S]
+    out, ref = np.asarray(out), np.asarray(ref)
+    np.testing.assert_allclose(out[valid_q], ref[valid_q],
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_segment_ids_isolation():
+    """Tokens in one segment are bit-wise independent of other segments'
+    content: perturbing segment 2 must not change segment 1's output."""
+    B, S, H, D = 1, 128, 2, 64
+    q, k, v = _qkv(9, B=B, S=S, H=H, D=D)
+    seg = jnp.asarray(np.repeat([[1, 2]], 64, axis=1).reshape(1, S))
+    out1 = flash_attention(q, k, v, q_segment_ids=seg, block_q=32,
+                           block_kv=32)
+    k2 = k.at[:, 64:].multiply(3.0)
+    v2 = v.at[:, 64:].add(7.0)
+    out2 = flash_attention(q, k2, v2, q_segment_ids=seg, block_q=32,
+                           block_kv=32)
+    np.testing.assert_array_equal(np.asarray(out1)[:, :64],
+                                  np.asarray(out2)[:, :64])
+    assert np.abs(np.asarray(out1)[:, 64:] -
+                  np.asarray(out2)[:, 64:]).max() > 1e-3
+
+
+def test_segment_ids_validation():
+    q, k, v = _qkv(10, B=1, S=64, H=2, D=64, kv_len=128)
+    seg = jnp.zeros((1, 64), jnp.int32)
+    with pytest.raises(ValueError, match="kv_segment_ids is required"):
+        flash_attention(q, k, v, q_segment_ids=seg)
+    with pytest.raises(ValueError, match="without q_segment_ids"):
+        flash_attention(q, k, v, kv_segment_ids=jnp.zeros((1, 128),
+                                                          jnp.int32))

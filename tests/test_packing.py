@@ -181,12 +181,20 @@ def test_packed_suffix_and_guards():
             config=DistilBertConfig.tiny(), max_len=64, packed=True,
             length_buckets=(16, 32),
         )
-    with pytest.raises(ValueError, match="dense"):
-        DistilBertClassifier(
-            config=dataclasses.replace(DistilBertConfig.tiny(),
-                                       attn_impl="flash"),
-            max_len=64, packed=True,
-        )
+
+
+def test_packed_composes_with_flash_attention():
+    """The Pallas flash kernel takes segment ids natively, so the packed
+    classifier runs on the flash path with the same labels/confidences as
+    the dense one (ops/flash_attention.py segment mode)."""
+    dense_cfg = _f32_tiny()
+    flash_cfg = dataclasses.replace(dense_cfg, attn_impl="flash")
+    dense = DistilBertClassifier(config=dense_cfg, max_len=64, seed=8,
+                                 packed=True)
+    flash = DistilBertClassifier(config=flash_cfg, max_len=64, seed=8,
+                                 packed=True)
+    flash.params = dense.params
+    assert flash.classify_batch(TEXTS) == dense.classify_batch(TEXTS)
 
 
 def test_packed_on_dp_mesh():

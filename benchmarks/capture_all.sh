@@ -26,9 +26,17 @@ suites=${*:-"roofline ingest flash_sweep generation coldstart joint llama_zerosh
 # deadline + margin so raising MUSICAAL_BENCH_DEADLINE_S never puts this
 # cap in a position to SIGTERM a healthy run mid-compile (lease-wedge
 # risk, CLAUDE.md).
-bench_deadline=${MUSICAAL_BENCH_DEADLINE_S:-480}
-bench_deadline=${bench_deadline%%.*}   # bench.py accepts floats; sh arithmetic doesn't
-case "$bench_deadline" in (""|*[!0-9]*) bench_deadline=480 ;; esac
+# Parse the deadline with the SAME semantics bench.py uses (float(),
+# non-finite/non-positive -> 480): a silent mismatch here could set the
+# cap below the deadline bench.py actually honors and SIGTERM a healthy
+# run mid-compile.
+bench_deadline=$(python -c '
+import math, os
+try:
+    v = float(os.environ.get("MUSICAAL_BENCH_DEADLINE_S", ""))
+except ValueError:
+    v = 480.0
+print(int(v) if math.isfinite(v) and v > 0 else 480)')
 suite_timeout=${MUSICAAL_CAPTURE_TIMEOUT_S:-$(( bench_deadline + 420 ))}
 
 for suite in $suites; do

@@ -25,20 +25,29 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG_INF = -1e30
 
 
-def _block_attn_update(q, k_blk, v_blk, q_pos, kv_pos, causal, m, l, o):
+def _block_attn_update(q, k_blk, v_blk, q_pos, kv_pos, causal, m, l, o,
+                       q_seg=None, kv_seg=None):
     """One online-softmax accumulation step against a K/V block."""
     scale = q.shape[-1] ** -0.5
     logits = (
         jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
     )
+    allowed = None
     if causal:
         allowed = kv_pos[None, None, None, :] <= q_pos[None, None, :, None]
+    if q_seg is not None:
+        # Block-diagonal over packed documents: same-segment pairs only.
+        seg_ok = q_seg[:, None, :, None] == kv_seg[:, None, None, :]
+        allowed = seg_ok if allowed is None else (allowed & seg_ok)
+    if allowed is not None:
         logits = jnp.where(allowed, logits, _NEG_INF)
     block_max = jnp.max(logits, axis=-1)                      # [B,H,Q]
     new_m = jnp.maximum(m, block_max)
     correction = jnp.exp(m - new_m)
     p = jnp.exp(logits - new_m[..., None])                    # [B,H,Q,K]
-    if causal:
+    if allowed is not None:
+        # _NEG_INF is finite, so a fully-masked row's exp() is 1, not 0 —
+        # re-zero the masked probabilities explicitly.
         p = jnp.where(allowed, p, 0.0)
     new_l = l * correction + p.sum(axis=-1)
     new_o = o * correction[..., None] + jnp.einsum(
@@ -47,8 +56,8 @@ def _block_attn_update(q, k_blk, v_blk, q_pos, kv_pos, causal, m, l, o):
     return new_m, new_l, new_o
 
 
-def ring_attention_local(q, k, v, axis_name: str, causal: bool = False,
-                         use_flash: bool = False):
+def ring_attention_local(q, k, v, segment_ids=None, *, axis_name: str,
+                         causal: bool = False, use_flash: bool = False):
     """Per-device body; call under ``shard_map`` with sequence sharded.
 
     Shapes per device: ``q,k,v [B, S/n, H, D]``.  Returns ``[B, S/n, H, D]``.
@@ -58,6 +67,11 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = False,
     hooks, then merges the per-hop ``(o, m, l)`` partials with the same
     online-softmax algebra — VMEM-blocked compute inside each hop, ICI
     ``ppermute`` between hops.
+
+    ``segment_ids`` ``[B, S/n]`` (sequence-sharded like ``q``) restricts
+    attention to same-segment pairs — the packed-documents long-context
+    pattern.  The local segment shard rotates around the ring with its
+    K/V block, so cross-device segment boundaries mask exactly.
     """
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -76,8 +90,20 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = False,
     # initial values as varying over the axis so the carry types line up.
     m, l, o = (jax.lax.pcast(x, (axis_name,), to="varying") for x in (m, l, o))
 
+    segmented = segment_ids is not None
+    # This device's own (query-side) segment shard never rotates; only the
+    # kv-side copy travels around the ring in the carry.
+    q_seg_loc = segment_ids.astype(jnp.int32) if segmented else None
+
     def body(step, carry):
-        k_blk, v_blk, m, l, o = carry
+        # The segment shard joins the carry ONLY when segmented (the bool
+        # is trace-static): unsegmented calls keep the original 5-tuple
+        # and pay zero extra ppermute traffic.
+        if segmented:
+            k_blk, v_blk, seg_blk, m, l, o = carry
+        else:
+            k_blk, v_blk, m, l, o = carry
+            seg_blk = None
         # After `step` rotations (each device passes K/V to the next ring
         # neighbor), this device holds the block originally owned by
         # idx - step.
@@ -89,6 +115,8 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = False,
                 q, k_blk, v_blk, causal=causal,
                 q_offset=idx * S_loc, kv_offset=owner * S_loc,
                 return_residuals=True,
+                q_segment_ids=q_seg_loc,
+                kv_segment_ids=seg_blk,
             )
             o_i = jnp.transpose(o_i, (0, 2, 1, 3))     # [B,H,Q,D]
             m_new = jnp.maximum(m, m_i)
@@ -106,14 +134,25 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = False,
             else:
                 k_use, v_use = k_blk, v_blk
             m, l, o = _block_attn_update(
-                q, k_use, v_use, q_pos, kv_pos, causal, m, l, o
+                q, k_use, v_use, q_pos, kv_pos, causal, m, l, o,
+                q_seg=q_seg_loc,
+                kv_seg=seg_blk,
             )
         perm = [(i, (i + 1) % n) for i in range(n)]
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        if segmented:
+            # The segment shard travels WITH its K/V block so cross-device
+            # segment boundaries mask exactly on every hop.
+            seg_blk = jax.lax.ppermute(seg_blk, axis_name, perm)
+            return k_blk, v_blk, seg_blk, m, l, o
         return k_blk, v_blk, m, l, o
 
-    _, _, m, l, o = jax.lax.fori_loop(0, n, body, (k, v, m, l, o))
+    if segmented:
+        init = (k, v, q_seg_loc, m, l, o)
+    else:
+        init = (k, v, m, l, o)
+    *_, m, l, o = jax.lax.fori_loop(0, n, body, init)
     out = o / jnp.maximum(l, 1e-30)[..., None]                # [B,H,Q,D]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)   # [B,Q,H,D]
 
@@ -126,18 +165,29 @@ def ring_attention(
     axis: str = "sp",
     causal: bool = False,
     use_flash: bool = False,
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
-    """Sequence-parallel attention: ``[B, S, H, D]`` sharded on S over ``axis``."""
+    """Sequence-parallel attention: ``[B, S, H, D]`` sharded on S over ``axis``.
+
+    ``segment_ids`` ``[B, S]`` adds block-diagonal masking over packed
+    documents; the ids shard over ``axis`` with the sequence and rotate
+    with the K/V blocks, so segments spanning device boundaries mask
+    exactly (composable with ``causal``).
+    """
+    body = partial(ring_attention_local, axis_name=axis, causal=causal,
+                   use_flash=use_flash)
+    n_in = 3 if segment_ids is None else 4
     fn = jax.jit(
         jax.shard_map(
-            partial(ring_attention_local, axis_name=axis, causal=causal,
-                    use_flash=use_flash),
+            body,
             mesh=mesh,
-            in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+            in_specs=(P(None, axis),) * n_in,
             out_specs=P(None, axis),
             # pallas_call outputs carry no varying-mesh-axis annotation;
             # skip the vma check on the flash path.
             check_vma=not use_flash,
         )
     )
-    return fn(q, k, v)
+    if segment_ids is None:
+        return fn(q, k, v)
+    return fn(q, k, v, segment_ids)

@@ -106,8 +106,20 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask, positions, cache: Optional[KVCache],
-                 lengths: Optional[jax.Array] = None):
+                 lengths: Optional[jax.Array] = None,
+                 segment_ids: Optional[jax.Array] = None):
         cfg = self.config
+        if segment_ids is not None and (
+            cache is not None or cfg.attn_impl != "flash"
+        ):
+            # Refuse rather than silently attend across documents: the
+            # dense impl expresses packing as `causal & same-segment` in
+            # the mask array (see tests/test_packed_decoder.py), and the
+            # decode/cache path has no packed-document support.
+            raise ValueError(
+                "segment_ids is consumed by the flash prefill path only; "
+                "fold the segment mask into `mask` for the dense impl"
+            )
         dtype = jnp.dtype(cfg.dtype)
         attn = MultiHeadAttention(
             n_heads=cfg.n_heads,
@@ -129,12 +141,16 @@ class LlamaBlock(nn.Module):
             )
         else:
             # Flash path: masking is fully described by flash_causal=True +
-            # lengths, so the (causal & padding) mask array stays out.
+            # lengths (+ optional packed-document segment_ids), so the
+            # (causal & padding) mask array stays out.  Dense callers fold
+            # segment masking into the mask array themselves.
             attn_out = attn(
                 h,
                 mask=None if cfg.attn_impl == "flash" else mask,
                 positions=positions,
                 lengths=lengths,
+                segment_ids=(segment_ids if cfg.attn_impl == "flash"
+                             else None),
             )
             new_cache = None
         x = x + attn_out
@@ -172,13 +188,17 @@ class LlamaModel(nn.Module):
         caches: Optional[List[KVCache]] = None,
         lengths: Optional[jax.Array] = None,       # [B] — flash path masks
         last_position: Optional[jax.Array] = None,  # [B] — see below
+        segment_ids: Optional[jax.Array] = None,   # [B, S] — packed docs
     ):
         # CONTRACT: with cfg.attn_impl == "flash" (and no caches), the
         # `mask` argument is NOT applied — attention is causal + key-
-        # padding-by-`lengths`, full stop.  Callers needing any other mask
-        # (sliding window, prefix-LM, cross-attention) must use the dense
-        # impl; MultiHeadAttention raises if a mask array reaches the
-        # flash branch directly.
+        # padding-by-`lengths` + optional same-segment (packed documents,
+        # ``segment_ids``; pair with per-segment-restarted ``positions``).
+        # Callers needing any other mask (sliding window, prefix-LM,
+        # cross-attention) must use the dense impl — where `mask` is
+        # arbitrary, so packed-causal is expressed there as
+        # ``causal & same-segment`` in the array; MultiHeadAttention
+        # raises if a mask array reaches the flash branch directly.
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=dtype,
@@ -187,7 +207,8 @@ class LlamaModel(nn.Module):
         for i in range(cfg.n_layers):
             cache_i = caches[i] if caches is not None else None
             x, new_cache = LlamaBlock(cfg, name=f"layer_{i}")(
-                x, mask, positions, cache_i, lengths
+                x, mask, positions, cache_i, lengths,
+                segment_ids=segment_ids,
             )
             if new_cache is not None:
                 new_caches.append(new_cache)

@@ -42,20 +42,64 @@ jax.tree_util.register_dataclass(
 )
 
 
-def causal_lm_loss(model, params, token_ids, lengths):
-    """Next-token cross-entropy with padding masked out."""
+def causal_lm_loss(model, params, token_ids, lengths, segment_ids=None):
+    """Next-token cross-entropy with padding masked out.
+
+    ``segment_ids`` ``[B, S]`` (contiguous document ids per row, 0 = pad)
+    turns a row into a *pack* of documents — the standard pretraining
+    data-efficiency move: attention is restricted to same-document pairs,
+    positions restart at every document boundary, and the loss skips the
+    cross-document boundary target (token t never predicts another
+    document's token t+1).  A packed row's per-token losses equal the
+    per-document rows' exactly (``tests/test_packed_training.py``).
+    """
     inputs = token_ids[:, :-1]
     targets = token_ids[:, 1:]
     S = inputs.shape[1]
-    positions = jnp.broadcast_to(jnp.arange(S), inputs.shape)
-    logits, _ = model.apply(
-        {"params": params}, inputs, positions, causal_mask(S, S, 0)
-    )
+    s_idx = jnp.arange(S)[None, :]
+    flash = model.config.attn_impl == "flash"
+    if segment_ids is None:
+        positions = jnp.broadcast_to(s_idx, inputs.shape)
+        logits, _ = model.apply(
+            {"params": params}, inputs, positions, causal_mask(S, S, 0)
+        )
+    else:
+        from music_analyst_tpu.models.layers import segment_mask
+
+        seg = segment_ids[:, :-1].astype(jnp.int32)
+        # Position = offset from the document's first token: cummax of
+        # the segment-start indices (contiguous ids ⇒ a start is any
+        # index whose left neighbor differs).
+        is_start = jnp.concatenate(
+            [jnp.ones((seg.shape[0], 1), bool), seg[:, 1:] != seg[:, :-1]],
+            axis=1,
+        )
+        start_idx = jax.lax.cummax(jnp.where(is_start, s_idx, 0), axis=1)
+        positions = s_idx - start_idx
+        # The flash path discards mask arrays by contract (models/llama.py)
+        # and takes the segment ids natively; the dense path folds them
+        # into the mask array.  Routing by impl here keeps both honest —
+        # tests pin packed ≡ separate on each.
+        if flash:
+            logits, _ = model.apply(
+                {"params": params}, inputs, positions, None,
+                segment_ids=seg,
+            )
+        else:
+            logits, _ = model.apply(
+                {"params": params}, inputs, positions,
+                causal_mask(S, S, 0) & segment_mask(seg),
+            )
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    valid = (jnp.arange(S)[None, :] < (lengths - 1)[:, None]).astype(
-        jnp.float32
-    )
+    valid = (s_idx < (lengths - 1)[:, None]).astype(jnp.float32)
+    if segment_ids is not None:
+        # Drop pad tokens and the last token of every document: its
+        # "next token" belongs to a different document.
+        same_doc = (segment_ids[:, :-1] == segment_ids[:, 1:])
+        valid = valid * (same_doc & (segment_ids[:, :-1] > 0)).astype(
+            jnp.float32
+        )
     return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
 
 
@@ -154,9 +198,10 @@ def make_train_step(model, optimizer, mesh: Optional[Mesh] = None):
     harmless otherwise.
     """
 
-    def step_fn(state: TrainState, token_ids, lengths):
+    def step_fn(state: TrainState, token_ids, lengths, segment_ids=None):
         loss, grads = jax.value_and_grad(
-            lambda p: causal_lm_loss(model, p, token_ids, lengths)
+            lambda p: causal_lm_loss(model, p, token_ids, lengths,
+                                     segment_ids=segment_ids)
         )(state.params)
         updates, new_opt = optimizer.update(
             grads, state.opt_state, state.params
@@ -176,10 +221,15 @@ def make_train_step(model, optimizer, mesh: Optional[Mesh] = None):
     batch_sharding = NamedSharding(mesh, P(dp, sp))
     lengths_sharding = NamedSharding(mesh, P(dp))
 
-    def sharded_step(state, token_ids, lengths):
+    def sharded_step(state, token_ids, lengths, segment_ids=None):
         token_ids = jax.lax.with_sharding_constraint(token_ids, batch_sharding)
         lengths = jax.lax.with_sharding_constraint(lengths, lengths_sharding)
-        return step_fn(state, token_ids, lengths)
+        if segment_ids is not None:
+            # Packed-document ids shard exactly like the tokens they label.
+            segment_ids = jax.lax.with_sharding_constraint(
+                segment_ids, batch_sharding
+            )
+        return step_fn(state, token_ids, lengths, segment_ids)
 
     def _shardings_of(state):
         return jax.tree_util.tree_map(
@@ -205,7 +255,7 @@ def make_train_step(model, optimizer, mesh: Optional[Mesh] = None):
     # (params + both Adam moments) in device memory.
     last_out = [None, None]  # [weakref to output state, jitted fn]
 
-    def pinned_step(state, token_ids, lengths):
+    def pinned_step(state, token_ids, lengths, segment_ids=None):
         if last_out[0] is not None and last_out[0]() is state:
             jitted = last_out[1]
         else:
@@ -221,7 +271,7 @@ def make_train_step(model, optimizer, mesh: Optional[Mesh] = None):
                     sharded_step, out_shardings=(shardings, None)
                 )
                 jitted_by_layout[key] = jitted
-        new_state, loss = jitted(state, token_ids, lengths)
+        new_state, loss = jitted(state, token_ids, lengths, segment_ids)
         last_out[0], last_out[1] = weakref.ref(new_state), jitted
         return new_state, loss
 

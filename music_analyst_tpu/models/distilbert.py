@@ -30,6 +30,7 @@ from music_analyst_tpu.models.layers import (
     GeluMLP,
     MultiHeadAttention,
     padding_mask,
+    segment_mask,
 )
 from music_analyst_tpu.models.tokenization import resolve_bert_tokenizer
 
@@ -120,8 +121,7 @@ class DistilBertEncoder(nn.Module):
             # head either way.
             mask = (
                 None if cfg.attn_impl == "flash"
-                else (segment_ids[:, None, :, None]
-                      == segment_ids[:, None, None, :])
+                else segment_mask(segment_ids)
             )
         else:
             mask = padding_mask(lengths, token_ids.shape[1])

@@ -357,3 +357,13 @@ def causal_mask(q_len: int, kv_len: int, offset) -> jax.Array:
 def padding_mask(lengths: jax.Array, max_len: int) -> jax.Array:
     """``[B, 1, 1, max_len]`` key-padding mask from per-row lengths."""
     return (jnp.arange(max_len)[None, :] < lengths[:, None])[:, None, None, :]
+
+
+def segment_mask(segment_ids: jax.Array) -> jax.Array:
+    """``[B, 1, S, S]`` block-diagonal mask from per-token segment ids.
+
+    Token pairs attend iff they share a segment id (packed batches /
+    packed documents).  The single definition shared by the encoder, the
+    training loss, and tests, so packing semantics can't drift per site.
+    """
+    return segment_ids[:, None, :, None] == segment_ids[:, None, None, :]

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from music_analyst_tpu.models.layers import causal_mask
+from music_analyst_tpu.models.layers import causal_mask, segment_mask
 from music_analyst_tpu.models.llama import LlamaConfig, LlamaModel
 
 CFG = LlamaConfig(
@@ -59,9 +59,7 @@ def _run(attn_impl):
 
     if attn_impl == "dense":
         # Dense path expresses packing in the mask array.
-        mask = causal_mask(S, S, 0) & (
-            seg[:, None, :, None] == seg[:, None, None, :]
-        )
+        mask = causal_mask(S, S, 0) & segment_mask(seg)
         packed_logits, _ = model.apply({"params": params}, ids, pos, mask)
     else:
         packed_logits, _ = model.apply(

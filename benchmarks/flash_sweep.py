@@ -73,6 +73,26 @@ def run() -> dict:
     flash_fn(q, k, v)
     long_s, _ = timed(lambda: flash_fn(q, k, v))
 
+    # Segment-mask overhead: same shape + causal, plus packed-document
+    # block-diagonal masking (8 contiguous docs per row).  The ids ride
+    # VMEM with the q/kv blocks, so the expected cost is a compare+and in
+    # the inner loop — this measures what that actually costs on chip.
+    S_seg = seqs[-1]
+    q, k, v = qkv(S_seg)
+    seg = jnp.repeat(
+        jnp.arange(1, 9, dtype=jnp.int32), S_seg // 8
+    )[None, :].repeat(B, 0)
+    seg_fn = jax.jit(
+        lambda q, k, v, seg: jnp.sum(
+            flash_attention(
+                q, k, v, causal=True, q_segment_ids=seg
+            ).astype(jnp.float32)
+        )
+    )
+    seg_fn(q, k, v, seg)
+    seg_s, _ = timed(lambda: seg_fn(q, k, v, seg))
+    base_s, _ = timed(lambda: flash_fn(q, k, v))
+
     sweep_rows = []
     S = seqs[-1]
     q, k, v = qkv(S)
@@ -104,6 +124,13 @@ def run() -> dict:
         "shape": f"B={B} H={H} D={D} bf16 causal",
         "dense_vs_flash": rows,
         "flash_long_context": {"seq": long_seq, "ms": round(long_s * 1e3, 2)},
+        "segment_mask_overhead": {
+            "seq": S_seg,
+            "n_docs": 8,
+            "flash_ms": round(base_s * 1e3, 2),
+            "flash_segmented_ms": round(seg_s * 1e3, 2),
+            "overhead": round(seg_s / base_s, 3),
+        },
         "block_sweep_at_seq": S,
         "block_sweep": sweep_rows,
     }

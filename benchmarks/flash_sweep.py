@@ -94,8 +94,7 @@ def run() -> dict:
     base_s, _ = timed(lambda: flash_fn(q, k, v))
 
     sweep_rows = []
-    S = seqs[-1]
-    q, k, v = qkv(S)
+    S = S_seg  # same shape as the segment section; reuse its q/k/v
     for bq, bkv in sweeps:
         if bq > S or bkv > S:
             continue

@@ -103,21 +103,19 @@ def _oracle_distilbert_labels(
     return labels
 
 
-def _oracle_llama_labels(
-    checkpoint_path: str, clf, texts: Sequence[str]
-) -> List[str]:
-    """Labels from transformers' LlamaForCausalLM, scoring the same label
-    continuations teacher-forced after the same prompt ids."""
-    import torch
+def build_llama_oracle(checkpoint_path: str, cfg):
+    """transformers' own LlamaForCausalLM loaded from the checkpoint.
+
+    Exposed separately from the label scoring so tests can pin logit
+    parity directly (label agreement on random tiny fixtures is chaotic
+    over ~250-token prompts — fp reduction-order noise can flip a near-tie
+    even when both models are exact; real finetuned weights separate the
+    labels by orders of magnitude more).
+    """
     import transformers
 
-    from music_analyst_tpu.models.llama import (
-        LYRICS_TRUNCATION,
-        PROMPT_TEMPLATE,
-        load_torch_state_dict,
-    )
+    from music_analyst_tpu.models.llama import load_torch_state_dict
 
-    cfg = clf.config
     # Same shard-merging reader as the backend: MUSICAAL_LLAMA_CKPT may be
     # a single file or a directory of pytorch_model-*.bin shards.
     sd = load_torch_state_dict(checkpoint_path)
@@ -150,6 +148,22 @@ def _oracle_llama_labels(
             f"missing={sorted(missing)[:4]} unexpected={sorted(unexpected)[:4]}"
         )
     model.eval()
+    return model
+
+
+def _oracle_llama_labels(
+    checkpoint_path: str, clf, texts: Sequence[str]
+) -> List[str]:
+    """Labels from transformers' LlamaForCausalLM, scoring the same label
+    continuations teacher-forced after the same prompt ids."""
+    import torch
+
+    from music_analyst_tpu.models.llama import (
+        LYRICS_TRUNCATION,
+        PROMPT_TEMPLATE,
+    )
+
+    model = build_llama_oracle(checkpoint_path, clf.config)
 
     label_ids = [
         [int(t) for t in clf._label_ids[k][: clf._label_lens[k]]]

@@ -106,6 +106,49 @@ def test_validate_llama(fixture_csv, tmp_path):
     assert report["agreement"] == 1.0, report["disagreements"]
 
 
+def test_validate_llama_tied_embeddings_oracle_logit_parity(tmp_path):
+    """Checkpoints without a separate lm_head (tied embeddings) flow
+    through the oracle's tie_word_embeddings branch, and the oracle model
+    matches our loader's model at the logit level (label agreement on
+    unscaled random fixtures is chaotic over long prompts, so the pin is
+    on logits — the quantity both scoring paths consume)."""
+    import jax
+    import jax.numpy as jnp
+
+    from music_analyst_tpu.engines.validate import build_llama_oracle
+    from music_analyst_tpu.models.layers import causal_mask
+    from music_analyst_tpu.models.llama import (
+        LlamaConfig,
+        LlamaModel,
+        load_hf_torch_checkpoint,
+    )
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype="float32")
+    sd = llama_state_dict(cfg, seed=6, tied=True)
+    assert "lm_head.weight" not in sd
+    ckpt = tmp_path / "pytorch_model.bin"
+    torch.save(sd, ckpt)
+
+    hf = build_llama_oracle(str(ckpt), cfg)
+    assert hf.config.tie_word_embeddings
+
+    model = LlamaModel(cfg)
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 16)), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(16), (2, 16))
+    params = model.init(jax.random.key(0), ids, pos,
+                        causal_mask(16, 16, 0))["params"]
+    params = load_hf_torch_checkpoint(params, str(ckpt))
+    ours, _ = model.apply({"params": params}, ids, pos,
+                          causal_mask(16, 16, 0))
+    with torch.no_grad():
+        theirs = hf(
+            torch.tensor(np.asarray(ids), dtype=torch.long)
+        ).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-3,
+                               atol=1e-3)
+
+
 def test_validate_requires_checkpoint(fixture_csv, monkeypatch):
     monkeypatch.delenv("MUSICAAL_DISTILBERT_CKPT", raising=False)
     with pytest.raises(RuntimeError, match="MUSICAAL_DISTILBERT_CKPT"):

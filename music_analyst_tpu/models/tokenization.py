@@ -19,12 +19,70 @@ absent.  Three tiers, best available wins:
 from __future__ import annotations
 
 import os
-import re
+import unicodedata
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-_WORD_RE = re.compile(r"[a-z0-9']+|[^\sa-z0-9']", re.IGNORECASE)
+_CJK_RANGES = (
+    (0x4E00, 0x9FFF), (0x3400, 0x4DBF), (0x20000, 0x2A6DF),
+    (0x2A700, 0x2B73F), (0x2B740, 0x2B81F), (0x2B820, 0x2CEAF),
+    (0xF900, 0xFAFF), (0x2F800, 0x2FA1F),
+)
+
+
+def _is_bert_punctuation(ch: str) -> bool:
+    """BERT treats the ASCII symbol ranges as punctuation in addition to
+    the Unicode P* categories (so ``$``, ``+``, `` ` `` split too)."""
+    cp = ord(ch)
+    if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
+            or 123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def bert_basic_tokenize(text: str) -> List[str]:
+    """HF ``BertTokenizer``'s BasicTokenizer (``do_lower_case=True``),
+    reimplemented exactly.
+
+    Clean control chars (every C* category, like HF's ``_is_control``),
+    isolate CJK ideographs, whitespace-split, lowercase + strip accents
+    (NFD, drop combining marks), then split punctuation into single-char
+    tokens.  The real-weights path depends on byte-exact agreement with
+    the checkpoint's tokenizer — ``tests/test_wordpiece_differential.py``
+    pins this function against ``transformers.BertTokenizer`` directly.
+    """
+    chars: List[str] = []
+    for ch in text:
+        cp = ord(ch)
+        cat = unicodedata.category(ch)
+        if ch in " \t\n\r" or cat == "Zs":
+            chars.append(" ")
+        elif cp == 0 or cp == 0xFFFD or cat.startswith("C"):
+            continue
+        elif any(lo <= cp <= hi for lo, hi in _CJK_RANGES):
+            chars.extend((" ", ch, " "))
+        else:
+            chars.append(ch)
+    tokens: List[str] = []
+    for token in "".join(chars).split():
+        token = token.lower()
+        token = unicodedata.normalize("NFD", token)
+        token = "".join(
+            c for c in token if unicodedata.category(c) != "Mn"
+        )
+        current: List[str] = []
+        for c in token:
+            if _is_bert_punctuation(c):
+                if current:
+                    tokens.append("".join(current))
+                    current = []
+                tokens.append(c)
+            else:
+                current.append(c)
+        if current:
+            tokens.append("".join(current))
+    return tokens
 
 
 class HashWordTokenizer:
@@ -181,7 +239,7 @@ class WordPieceTokenizer:
 
     def encode(self, text: str, max_len: int) -> Tuple[np.ndarray, int]:
         ids: List[int] = [self.cls_id]
-        for word in _WORD_RE.findall(text.lower()):
+        for word in bert_basic_tokenize(text):
             ids.extend(self._wordpiece(word))
             if len(ids) >= max_len - 1:
                 break

@@ -1,0 +1,104 @@
+"""WordPieceTokenizer ≡ transformers.BertTokenizer on the same vocab.
+
+The real-weights path (``MUSICAAL_BERT_VOCAB`` + ``MUSICAAL_DISTILBERT_
+CKPT``) is only as good as its tokenization: a single divergent id feeds
+the checkpoint garbage.  This differential pins our offline WordPiece +
+BasicTokenizer reimplementation against HF's own slow ``BertTokenizer``
+(the checkpoint family's reference implementation) over adversarial and
+randomized corpora.  Caught on introduction: missing accent stripping and
+apostrophes not splitting as punctuation.
+"""
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+
+from music_analyst_tpu.models.tokenization import (  # noqa: E402
+    WordPieceTokenizer,
+    bert_basic_tokenize,
+)
+
+VOCAB = [
+    "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+    "the", "love", "##ing", "##s", "rain", "un", "##known", "a", "b",
+    "##c", ".", ",", "!", "'", "cafe", "don", "##t", "##'", "t", "$",
+    "##ely", "lone", "night", "##time", "2", "##4", "7", "-",
+]
+
+
+@pytest.fixture(scope="module")
+def pair(tmp_path_factory):
+    path = tmp_path_factory.mktemp("vocab") / "vocab.txt"
+    path.write_text("\n".join(VOCAB) + "\n", encoding="utf-8")
+    return (
+        WordPieceTokenizer(str(path)),
+        transformers.BertTokenizer(vocab_file=str(path),
+                                   do_lower_case=True),
+    )
+
+
+ADVERSARIAL = [
+    "love loving rains",
+    "UNKNOWNWORD love",
+    "love, rain!  night-time 24/7",
+    "café Café CAFÉ",                  # accent stripping
+    "don't Don'T",                      # apostrophe is punctuation
+    "a\tb\nc\r\x00d�",            # controls cleaned, whitespace kept
+    "ab a￾b",               # Co private-use / Cn nonchar drop
+    "the   the the",              # NBSP is Zs whitespace
+    "$$$ lone.ly...",
+    "",
+    "   ",
+    "love" * 50,                        # > max_word_chars -> UNK
+    "爱 the 愛love",                    # CJK chars isolate
+    "naïve résumé",                    # only accents differ from vocab
+]
+
+
+def _ours(tok, text, max_len=32):
+    row, n = tok.encode(text, max_len)
+    return [int(t) for t in row[:n]]
+
+
+def test_adversarial_corpus_matches_hf(pair):
+    ours, hf = pair
+    for text in ADVERSARIAL:
+        want = hf.encode(text, truncation=True, max_length=32)
+        got = _ours(ours, text)
+        assert got == want, (text, got, want)
+
+
+def test_randomized_corpus_matches_hf(pair):
+    """Seeded fuzz: random mixes of vocab pieces, unknowns, punctuation,
+    unicode and whitespace."""
+    ours, hf = pair
+    rng = np.random.default_rng(0)
+    pieces = ["love", "the", "rain", "unknown", "zzz", "don't", "café",
+              ",", "!", ".", "$", "a", "b", "C", "愛", "naïve", "''",
+              "  ", "\t", "x" * 120, "24", "7-7"]
+    for _ in range(200):
+        n = rng.integers(0, 12)
+        text = "".join(
+            rng.choice(pieces) + (" " if rng.random() < 0.7 else "")
+            for _ in range(n)
+        )
+        want = hf.encode(text, truncation=True, max_length=24)
+        got = _ours(ours, text, max_len=24)
+        assert got == want, (text, got, want)
+
+
+def test_basic_tokenize_matches_hf_basic(pair):
+    _, hf = pair
+    basic = hf.basic_tokenizer
+    for text in ADVERSARIAL:
+        assert bert_basic_tokenize(text) == basic.tokenize(text), text
+
+
+def test_truncation_parity(pair):
+    ours, hf = pair
+    text = "love loving rains " * 20
+    for max_len in (4, 8, 16):
+        want = hf.encode(text, truncation=True, max_length=max_len)
+        got = _ours(ours, text, max_len=max_len)
+        assert got == want, (max_len, got, want)

@@ -35,6 +35,12 @@ from music_analyst_tpu.models.layers import (
 from music_analyst_tpu.models.tokenization import resolve_bert_tokenizer
 
 
+# HF DistilBERT hardcodes nn.LayerNorm(eps=1e-12) (flax defaults to
+# 1e-6); match it exactly so real checkpoints reproduce the reference
+# forward — the oracle tests share this constant.
+LN_EPS = 1e-12
+
+
 @dataclasses.dataclass(frozen=True)
 class DistilBertConfig:
     vocab_size: int = 30522
@@ -76,10 +82,14 @@ class TransformerBlock(nn.Module):
         )(x, mask=None if cfg.attn_impl == "flash" else mask,
           lengths=lengths,
           segment_ids=segment_ids if cfg.attn_impl == "flash" else None)
-        x = nn.LayerNorm(name="sa_layer_norm", dtype=dtype)(x + attn_out)
+        x = nn.LayerNorm(
+            name="sa_layer_norm", dtype=dtype, epsilon=LN_EPS
+        )(x + attn_out)
         mlp_out = GeluMLP(cfg.hidden_dim, dtype=dtype, quant=cfg.quant,
                           name="ffn")(x)
-        return nn.LayerNorm(name="output_layer_norm", dtype=dtype)(x + mlp_out)
+        return nn.LayerNorm(
+            name="output_layer_norm", dtype=dtype, epsilon=LN_EPS
+        )(x + mlp_out)
 
 
 class DistilBertEncoder(nn.Module):
@@ -109,7 +119,9 @@ class DistilBertEncoder(nn.Module):
                        name="word_embeddings")(token_ids)
         pos = nn.Embed(cfg.max_positions, cfg.dim, dtype=dtype,
                        name="position_embeddings")(positions)
-        x = nn.LayerNorm(name="embed_layer_norm", dtype=dtype)(tok + pos)
+        x = nn.LayerNorm(
+            name="embed_layer_norm", dtype=dtype, epsilon=LN_EPS
+        )(tok + pos)
         if segment_ids is not None:
             # Block-diagonal: token pairs attend iff same segment.  The
             # dense impl gets a mask array; the flash kernel takes the

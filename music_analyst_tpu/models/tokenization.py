@@ -205,7 +205,11 @@ class WordPieceTokenizer:
     unknown words map to ``[UNK]``.
     """
 
+    SPECIAL_TOKENS = ("[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]")
+
     def __init__(self, vocab_path: str, max_word_chars: int = 100) -> None:
+        import re
+
         with open(vocab_path, encoding="utf-8") as fh:
             self.vocab = {line.rstrip("\n"): i for i, line in enumerate(fh)}
         self.pad_id = self.vocab.get("[PAD]", 0)
@@ -214,6 +218,16 @@ class WordPieceTokenizer:
         self.unk_id = self.vocab.get("[UNK]", 100)
         self.max_word_chars = max_word_chars
         self.vocab_size = len(self.vocab)
+        # HF passes never_split=all_special_tokens to its basic tokenizer:
+        # a literal "[MASK]" in the text stays one token (case-sensitive,
+        # anywhere in the string), it is not lowercased or punct-split.
+        self._specials = frozenset(
+            t for t in self.SPECIAL_TOKENS if t in self.vocab
+        )
+        self._special_re = (
+            re.compile("(" + "|".join(map(re.escape, self._specials)) + ")")
+            if self._specials else None
+        )
 
     def _wordpiece(self, word: str) -> List[int]:
         if len(word) > self.max_word_chars:
@@ -239,10 +253,19 @@ class WordPieceTokenizer:
 
     def encode(self, text: str, max_len: int) -> Tuple[np.ndarray, int]:
         ids: List[int] = [self.cls_id]
-        for word in bert_basic_tokenize(text):
-            ids.extend(self._wordpiece(word))
+        chunks = (
+            self._special_re.split(text) if self._special_re else [text]
+        )
+        for chunk in chunks:
             if len(ids) >= max_len - 1:
                 break
+            if chunk in self._specials:
+                ids.append(self.vocab[chunk])
+                continue
+            for word in bert_basic_tokenize(chunk):
+                ids.extend(self._wordpiece(word))
+                if len(ids) >= max_len - 1:
+                    break
         ids = ids[: max_len - 1] + [self.sep_id]
         out = np.full(max_len, self.pad_id, dtype=np.int32)
         out[: len(ids)] = ids

@@ -27,9 +27,9 @@ CFG = DistilBertConfig(
     vocab_size=128, dim=32, n_layers=2, n_heads=4, hidden_dim=64,
     max_positions=16, dtype="float32",
 )
-# Flax nn.LayerNorm default epsilon — the model's documented norm epsilon;
-# the oracle must use the same one to isolate mapping errors from eps noise.
-LN_EPS = 1e-6
+# The model pins HF DistilBERT's hardcoded epsilon; the oracle must use
+# the same one to isolate mapping errors from eps noise.
+from music_analyst_tpu.models.distilbert import LN_EPS  # noqa: E402
 
 
 def _hf_state_dict(cfg: DistilBertConfig, seed: int = 0):

@@ -53,6 +53,9 @@ ADVERSARIAL = [
     "love" * 50,                        # > max_word_chars -> UNK
     "爱 the 愛love",                    # CJK chars isolate
     "naïve résumé",                    # only accents differ from vocab
+    "the [MASK] love",                  # literal specials never split
+    "the[MASK]love [SEP] [mask] [UNK]x",
+    "[CLS] [PAD][PAD]",
 ]
 
 
@@ -76,7 +79,8 @@ def test_randomized_corpus_matches_hf(pair):
     rng = np.random.default_rng(0)
     pieces = ["love", "the", "rain", "unknown", "zzz", "don't", "café",
               ",", "!", ".", "$", "a", "b", "C", "愛", "naïve", "''",
-              "  ", "\t", "x" * 120, "24", "7-7"]
+              "  ", "\t", "x" * 120, "24", "7-7", "[MASK]", "[SEP]",
+              "[mask]"]
     for _ in range(200):
         n = rng.integers(0, 12)
         text = "".join(

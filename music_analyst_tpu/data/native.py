@@ -135,6 +135,28 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p,      # out ids
         ctypes.c_void_p,      # out lens
     ]
+    lib.man_wp_create.restype = ctypes.c_void_p
+    lib.man_wp_create.argtypes = [
+        ctypes.c_char_p,      # vocab blob (newline-separated entries)
+        ctypes.c_longlong,    # blob bytes
+        ctypes.c_int,         # max_word_chars
+        ctypes.c_void_p,      # char class table uint8[N]
+        ctypes.c_int,         # N (table codepoint bound)
+        ctypes.c_char_p,      # replacement blob
+        ctypes.c_void_p,      # replacement offsets int32[N+1]
+    ]
+    lib.man_wp_destroy.argtypes = [ctypes.c_void_p]
+    lib.man_wp_encode_batch.argtypes = [
+        ctypes.c_void_p,      # vocab handle
+        ctypes.c_char_p,      # blob
+        ctypes.c_void_p,      # offsets int64[n+1]
+        ctypes.c_longlong,    # n_rows
+        ctypes.c_int,         # max_len
+        ctypes.c_int,         # num_threads
+        ctypes.c_void_p,      # out ids
+        ctypes.c_void_p,      # out lens
+        ctypes.c_void_p,      # handled uint8[n]
+    ]
 
 
 def available() -> bool:
@@ -182,6 +204,74 @@ def hash_tokenize_batch(
         lens.ctypes.data_as(ctypes.c_void_p),
     )
     return out, lens
+
+
+def wp_create(
+    vocab_path: str, char_table, max_word_chars: int = 100
+) -> Optional[int]:
+    """Build a native WordPiece vocab handle; None when unavailable or the
+    vocab lacks [CLS]/[SEP] (the Python tokenizer raises on those).
+
+    ``char_table`` is ``(classes, repl_blob, offsets)`` from
+    ``models/tokenization.py:_wp_char_table`` — the Python-owned Unicode
+    semantics the kernel executes.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    with open(vocab_path, "rb") as fh:
+        blob = fh.read()
+    classes, repl_blob, offsets = char_table
+    classes = np.ascontiguousarray(classes, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int32)
+    handle = lib.man_wp_create(
+        blob,
+        ctypes.c_longlong(len(blob)),
+        max_word_chars,
+        classes.ctypes.data_as(ctypes.c_void_p),
+        int(classes.size),
+        repl_blob,
+        offsets.ctypes.data_as(ctypes.c_void_p),
+    )
+    return handle or None
+
+
+def wp_destroy(handle: int) -> None:
+    lib = _load()
+    if lib is not None and handle:
+        lib.man_wp_destroy(ctypes.c_void_p(handle))
+
+
+def wp_encode_batch(handle: int, texts, max_len: int, num_threads: int = 0):
+    """C++ Latin-fast-path WordPiece; returns ``(ids, lens, handled)``.
+
+    Rows with ``handled == 0`` — a codepoint past the char table
+    (≥ U+0370: Greek/Cyrillic/CJK/emoji), invalid UTF-8, or a degenerate
+    ``max_len`` — must be re-encoded by the Python tokenizer.  Accented
+    Latin rows ARE handled natively (the table covers < U+0370)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_load_error}")
+    encoded = [t.encode("utf-8", errors="replace") for t in texts]
+    offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
+    np.cumsum([len(e) for e in encoded], out=offsets[1:])
+    blob = b"".join(encoded)
+    n = len(encoded)
+    out = np.empty((n, max_len), dtype=np.int32)
+    lens = np.empty(n, dtype=np.int32)
+    handled = np.empty(n, dtype=np.uint8)
+    lib.man_wp_encode_batch(
+        ctypes.c_void_p(handle),
+        blob,
+        offsets.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_longlong(n),
+        max_len,
+        num_threads,
+        out.ctypes.data_as(ctypes.c_void_p),
+        lens.ctypes.data_as(ctypes.c_void_p),
+        handled.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out, lens, handled
 
 
 def split_columns_native(

@@ -378,11 +378,100 @@ def resolve_llama_tokenizer(
     return ByteTokenizer(vocab_size)
 
 
+# Codepoints below this bound are classified/normalized by a table the
+# Python side builds from unicodedata and hands to the native kernel:
+# ASCII + Latin-1 Supplement + Latin Extended-A/B + IPA + combining
+# diacriticals — i.e. every Western-language lyric.  Greek and beyond
+# (0x370+) fall back to the Python path per row: lowercasing there can be
+# context-dependent (final sigma), which a per-char table can't express.
+_WP_TABLE_MAX = 0x370
+
+
+def _wp_char_table():
+    """``(classes, repl_blob, offsets)`` for the native WordPiece kernel.
+
+    ``classes[cp]``: 0=drop (C* controls), 1=whitespace, 2=punctuation,
+    3=word char.  ``repl`` is the per-char normalization BERT applies
+    inside a token — lowercase, NFD, strip combining marks — as UTF-8
+    bytes (empty for a bare combining mark, multi-byte where the
+    lowercased base keeps a non-ASCII char like ``ø``).  Derived from the
+    same unicodedata calls ``bert_basic_tokenize`` makes, so the native
+    path can't drift from the Python semantics.
+    """
+    classes = np.zeros(_WP_TABLE_MAX, np.uint8)
+    repls = []
+    for cp in range(_WP_TABLE_MAX):
+        ch = chr(cp)
+        cat = unicodedata.category(ch)
+        if ch in " \t\n\r" or cat == "Zs":
+            classes[cp] = 1
+            repls.append(b"")
+        elif cp == 0 or cat.startswith("C"):
+            classes[cp] = 0
+            repls.append(b"")
+        elif _is_bert_punctuation(ch):
+            classes[cp] = 2
+            repls.append(ch.encode("utf-8"))
+        else:
+            classes[cp] = 3
+            norm = "".join(
+                c for c in unicodedata.normalize("NFD", ch.lower())
+                if unicodedata.category(c) != "Mn"
+            )
+            repls.append(norm.encode("utf-8"))
+    offsets = np.zeros(_WP_TABLE_MAX + 1, np.int32)
+    np.cumsum([len(r) for r in repls], out=offsets[1:])
+    return classes, b"".join(repls), offsets
+
+
+class NativeWordPieceTokenizer(WordPieceTokenizer):
+    """C++-accelerated batch WordPiece with identical output.
+
+    Latin-script rows (every Western-language lyric, accents included)
+    encode in the threaded native kernel
+    (``native/ingest.cpp:man_wp_encode_batch``) driven by the
+    :func:`_wp_char_table` classification; rows the kernel flags
+    (codepoints ≥ U+0370 or invalid UTF-8) re-encode through the Python
+    path, which owns the full-Unicode BasicTokenizer semantics.  Python
+    WordPiece runs ~10x slower than the DistilBERT device forward, so
+    without this the real-weights path is tokenizer-bound.
+    """
+
+    def __init__(self, vocab_path: str, max_word_chars: int = 100) -> None:
+        super().__init__(vocab_path, max_word_chars)
+        from music_analyst_tpu.data import native
+
+        self._native = native
+        self._handle = (
+            native.wp_create(vocab_path, _wp_char_table(), max_word_chars)
+            if native.available() else None
+        )
+
+    def encode_batch(
+        self, texts: Sequence[str], max_len: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        if self._handle is None:
+            return super().encode_batch(texts, max_len)
+        batch, lengths, handled = self._native.wp_encode_batch(
+            self._handle, texts, max_len
+        )
+        for i in np.flatnonzero(handled == 0):
+            row, n = self.encode(texts[i], max_len)
+            batch[i] = row
+            lengths[i] = n
+        return batch, lengths
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._native.wp_destroy(handle)
+
+
 def resolve_bert_tokenizer(
     vocab_path: Optional[str] = None, vocab_size: int = 30522
 ):
     """Best-available encoder tokenizer (WordPiece if a vocab is supplied)."""
     path = vocab_path or os.environ.get("MUSICAAL_BERT_VOCAB")
     if path and os.path.exists(path):
-        return WordPieceTokenizer(path)
+        return NativeWordPieceTokenizer(path)
     return NativeHashTokenizer(vocab_size=vocab_size)

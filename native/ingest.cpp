@@ -32,6 +32,8 @@
 #include <cstring>
 #include <string>
 #include <thread>
+#include <unordered_map>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -733,6 +735,260 @@ long long man_record_ranges(const char* path, int n_procs, int p,
 
 // texts: concatenated UTF-8 blob; offsets: int64[n_rows+1]; out int32
 // [n_rows, max_len]; out_lens int32 [n_rows].
+// ---------------------------------------------------------------------------
+// WordPiece batch tokenizer (Latin fast path).
+//
+// Byte-exact with models/tokenization.py (bert_basic_tokenize +
+// WordPieceTokenizer, themselves differentially pinned against HF's
+// BertTokenizer): whitespace split, control-char removal, single-char
+// punctuation tokens, never_split special tokens, per-char lowering /
+// accent stripping, greedy longest-match-first ##-continuation subwords.
+// The Unicode knowledge (categories, lowercase, NFD) lives in a table
+// the PYTHON side builds from unicodedata for codepoints < 0x370
+// (ASCII + the Latin blocks — every Western-language lyric) and hands to
+// man_wp_create, so the native path cannot drift from the Python
+// semantics.  Rows containing codepoints beyond the table (Greek has
+// context-dependent lowercasing, CJK needs isolation) or invalid UTF-8
+// are flagged unhandled and re-encoded by the Python fallback.  The
+// Python WordPiece is ~10x slower than the DistilBERT device forward —
+// this kernel is the real-weights throughput unlock.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct WordPieceVocab {
+  std::unordered_map<std::string, int32_t> map;
+  std::vector<std::pair<std::string, int32_t>> specials;  // never_split
+  // Per-codepoint class (0=drop, 1=ws, 2=punct, 3=word) + normalized
+  // replacement bytes, Python-built (models/tokenization.py).
+  std::vector<unsigned char> cls_table;
+  std::vector<std::string> repl;
+  int32_t cls_id = -1, sep_id = -1, pad_id = 0, unk_id = 100;
+  int32_t max_word_chars = 100;
+};
+
+void wp_emit_word(const WordPieceVocab& v, const std::string& word,
+                  int32_t word_chars, std::vector<int32_t>* ids,
+                  std::string* buf, std::vector<int32_t>* pieces) {
+  // Length limit counts CHARACTERS (Python len), not UTF-8 bytes.  The
+  // greedy byte-prefix search below still equals Python's char-prefix
+  // search: a slice ending mid-char is invalid UTF-8 and can never match
+  // a (valid UTF-8) vocab entry.
+  if (word_chars > v.max_word_chars) {
+    ids->push_back(v.unk_id);
+    return;
+  }
+  pieces->clear();
+  size_t start = 0;
+  while (start < word.size()) {
+    size_t end = word.size();
+    int32_t cur = -1;
+    while (start < end) {
+      buf->assign(start > 0 ? "##" : "");
+      buf->append(word, start, end - start);
+      auto it = v.map.find(*buf);
+      if (it != v.map.end()) {
+        cur = it->second;
+        break;
+      }
+      --end;
+    }
+    if (cur < 0) {  // whole word becomes [UNK], matched pieces discarded
+      ids->push_back(v.unk_id);
+      return;
+    }
+    pieces->push_back(cur);
+    start = end;
+  }
+  ids->insert(ids->end(), pieces->begin(), pieces->end());
+}
+
+// Returns 1 when every codepoint sat inside the table and the row was
+// encoded; 0 = Python fallback (nothing written).
+int wp_encode_row(const WordPieceVocab& v, const unsigned char* s, size_t n,
+                  int32_t max_len, int32_t* out, int32_t* out_len,
+                  std::vector<int32_t>* ids, std::string* word,
+                  std::string* buf, std::vector<int32_t>* pieces) {
+  if (max_len < 2) return 0;  // no room for [CLS]+[SEP]; the Python
+                              // fallback raises cleanly, never write OOB
+  const size_t table_n = v.cls_table.size();
+  ids->clear();
+  ids->push_back(v.cls_id);
+  word->clear();
+  int32_t word_chars = 0;
+  const size_t limit = (size_t)max_len - 1;
+  bool stopped = false;
+  size_t i = 0;
+  while (i < n) {
+    if (ids->size() >= limit) {
+      stopped = true;
+      break;
+    }
+    if (s[i] == '[') {
+      const std::pair<std::string, int32_t>* hit = nullptr;
+      for (const auto& sp : v.specials) {
+        if (i + sp.first.size() <= n &&
+            std::memcmp(s + i, sp.first.data(), sp.first.size()) == 0) {
+          hit = &sp;
+          break;
+        }
+      }
+      if (hit != nullptr) {
+        if (!word->empty()) {
+          wp_emit_word(v, *word, word_chars, ids, buf, pieces);
+          word->clear();
+          word_chars = 0;
+        }
+        if (ids->size() >= limit) {
+          stopped = true;
+          break;
+        }
+        ids->push_back(hit->second);
+        i += hit->first.size();
+        continue;
+      }
+    }
+    unsigned char b = s[i];
+    uint32_t cp;
+    size_t clen;
+    if (b < 0x80) {
+      cp = b;
+      clen = 1;
+    } else if (b >= 0xC0 && b < 0xE0) {
+      // 2-byte sequence: codepoints 0x80..0x7FF — may sit in the table.
+      if (i + 1 >= n || (s[i + 1] & 0xC0) != 0x80) return 0;  // invalid
+      cp = ((uint32_t)(b & 0x1F) << 6) | (uint32_t)(s[i + 1] & 0x3F);
+      clen = 2;
+    } else {
+      // 3/4-byte sequences start at 0x800, past any table this kernel
+      // is given; stray continuation bytes are invalid UTF-8.
+      return 0;
+    }
+    if (cp >= table_n) return 0;
+    switch (v.cls_table[cp]) {
+      case 1:  // whitespace
+        if (!word->empty()) {
+          wp_emit_word(v, *word, word_chars, ids, buf, pieces);
+          word->clear();
+          word_chars = 0;
+        }
+        break;
+      case 0:  // control: REMOVED before wordization ("a\0b" -> "ab"),
+        break;  // exactly like the Python/HF clean-text pass
+      case 2:  // punctuation: its own single-char token
+        if (!word->empty()) {
+          wp_emit_word(v, *word, word_chars, ids, buf, pieces);
+          word->clear();
+          word_chars = 0;
+        }
+        if (ids->size() >= limit) {
+          stopped = true;
+        } else {
+          wp_emit_word(v, v.repl[cp], 1, ids, buf, pieces);
+        }
+        break;
+      default:  // word char: append the normalized replacement bytes
+        // (empty for a bare combining mark, which adds no char either)
+        if (!v.repl[cp].empty()) {
+          word->append(v.repl[cp]);
+          // The replacement's char count: ASCII bytes count 1 each;
+          // UTF-8 continuation bytes (0b10xxxxxx) don't start a char.
+          for (unsigned char rb : v.repl[cp]) {
+            if ((rb & 0xC0) != 0x80) ++word_chars;
+          }
+        }
+        break;
+    }
+    if (stopped) break;
+    i += clen;
+  }
+  if (!stopped && !word->empty()) {
+    wp_emit_word(v, *word, word_chars, ids, buf, pieces);
+  }
+  if (ids->size() > limit) ids->resize(limit);
+  ids->push_back(v.sep_id);
+  *out_len = (int32_t)ids->size();
+  for (size_t j = 0; j < ids->size(); ++j) out[j] = (*ids)[j];
+  for (int32_t j = *out_len; j < max_len; ++j) out[j] = v.pad_id;
+  return 1;
+}
+
+}  // namespace
+
+void* man_wp_create(const char* vocab_blob, long long n_bytes,
+                    int max_word_chars, const unsigned char* cls_table,
+                    int table_n, const char* repl_blob,
+                    const int32_t* repl_offsets) {
+  auto* v = new WordPieceVocab();
+  v->max_word_chars = max_word_chars;
+  v->cls_table.assign(cls_table, cls_table + table_n);
+  v->repl.reserve(table_n);
+  for (int c = 0; c < table_n; ++c) {
+    v->repl.emplace_back(repl_blob + repl_offsets[c],
+                         (size_t)(repl_offsets[c + 1] - repl_offsets[c]));
+  }
+  const char* p = vocab_blob;
+  const char* endp = vocab_blob + n_bytes;
+  int32_t idx = 0;
+  while (p < endp) {
+    const char* nl = (const char*)memchr(p, '\n', (size_t)(endp - p));
+    size_t len = nl ? (size_t)(nl - p) : (size_t)(endp - p);
+    if (len > 0 && p[len - 1] == '\r') --len;  // \r\n files, like text mode
+    // Assignment (not emplace): duplicate lines keep the LAST index, the
+    // Python dict-comprehension behavior.
+    v->map[std::string(p, len)] = idx++;
+    p = nl ? nl + 1 : endp;
+  }
+  auto find = [&](const char* t) -> int32_t {
+    auto it = v->map.find(t);
+    return it == v->map.end() ? (int32_t)-1 : it->second;
+  };
+  v->cls_id = find("[CLS]");
+  v->sep_id = find("[SEP]");
+  if (v->cls_id < 0 || v->sep_id < 0) {
+    delete v;
+    return nullptr;  // Python raises on these; never half-work natively
+  }
+  int32_t pad = find("[PAD]");
+  v->pad_id = pad >= 0 ? pad : 0;
+  int32_t unk = find("[UNK]");
+  v->unk_id = unk >= 0 ? unk : 100;
+  for (const char* t : {"[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"}) {
+    int32_t id = find(t);
+    if (id >= 0) v->specials.emplace_back(t, id);
+  }
+  return v;
+}
+
+void man_wp_destroy(void* handle) { delete (WordPieceVocab*)handle; }
+
+void man_wp_encode_batch(const void* handle, const char* blob,
+                         const long long* offsets, long long n_rows,
+                         int max_len, int num_threads, int32_t* out,
+                         int32_t* out_lens, unsigned char* handled) {
+  const WordPieceVocab& v = *(const WordPieceVocab*)handle;
+  unsigned threads = resolve_threads(num_threads);
+  if ((long long)threads > n_rows) threads = n_rows > 0 ? (unsigned)n_rows : 1;
+  std::vector<std::thread> pool;
+  long long per = n_rows / threads + 1;
+  for (unsigned t = 0; t < threads; ++t) {
+    long long rb = std::min((long long)t * per, n_rows);
+    long long re = std::min(rb + per, n_rows);
+    pool.emplace_back([=, &v]() {
+      std::vector<int32_t> ids, pieces;
+      std::string word, buf;
+      for (long long r = rb; r < re; ++r) {
+        handled[r] = (unsigned char)wp_encode_row(
+            v, (const unsigned char*)blob + offsets[r],
+            (size_t)(offsets[r + 1] - offsets[r]), max_len,
+            out + (long long)r * max_len, out_lens + r, &ids, &word, &buf,
+            &pieces);
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
 void man_hash_tokenize_batch(const char* blob, const long long* offsets,
                              long long n_rows, int max_len, int vocab_size,
                              int cls_id, int sep_id, int pad_id, int reserved,

@@ -106,3 +106,64 @@ def test_truncation_parity(pair):
         want = hf.encode(text, truncation=True, max_length=max_len)
         got = _ours(ours, text, max_len=max_len)
         assert got == want, (max_len, got, want)
+
+
+def test_native_wordpiece_matches_python(pair, tmp_path_factory):
+    """The C++ ASCII fast path is byte-exact with the Python tokenizer
+    (itself pinned to HF above), and non-ASCII rows fall back."""
+    from music_analyst_tpu.data import native
+    from music_analyst_tpu.models.tokenization import (
+        NativeWordPieceTokenizer,
+    )
+
+    if not native.available():
+        pytest.skip(f"native lib unavailable: {native.unavailable_reason()}")
+    path = tmp_path_factory.mktemp("nvocab") / "vocab.txt"
+    path.write_text("\n".join(VOCAB) + "\n", encoding="utf-8")
+    py = WordPieceTokenizer(str(path))
+    nat = NativeWordPieceTokenizer(str(path))
+    assert nat._handle is not None
+
+    corpora = ADVERSARIAL + [
+        "pure ascii love rain the don't $ 24/7 [MASK] x" * 3,
+        "latin café naïve søster ßüber",      # table-handled, not fallback
+        "the ελληνικά row",                   # Greek: per-row fallback
+        "爱 love 愛",                          # CJK: per-row fallback
+    ]
+    for max_len in (8, 32):
+        want_ids, want_lens = py.encode_batch(corpora, max_len)
+        got_ids, got_lens = nat.encode_batch(corpora, max_len)
+        np.testing.assert_array_equal(got_ids, want_ids)
+        np.testing.assert_array_equal(got_lens, want_lens)
+
+    # The Latin table really gets exercised natively, not via fallback.
+    _, _, handled = native.wp_encode_batch(
+        nat._handle, ["café søster don't"], 16
+    )
+    assert handled[0] == 1
+
+    rng = np.random.default_rng(1)
+    pieces = ["love", "the", "rain", "zzz", "don't", ",", "!", "$", "a",
+              "[MASK]", "[SEP]", "x" * 120, "24", "7-7", "\t", "  ",
+              "café", "naïve", "«quoted»", "ßü"]
+    fuzz = [
+        "".join(rng.choice(pieces) + (" " if rng.random() < 0.7 else "")
+                for _ in range(rng.integers(0, 14)))
+        for _ in range(300)
+    ]
+    want_ids, want_lens = py.encode_batch(fuzz, 24)
+    got_ids, got_lens = nat.encode_batch(fuzz, 24)
+    np.testing.assert_array_equal(got_ids, want_ids)
+    np.testing.assert_array_equal(got_lens, want_lens)
+
+
+def test_native_wordpiece_refuses_vocab_without_specials(tmp_path_factory):
+    from music_analyst_tpu.data import native
+
+    if not native.available():
+        pytest.skip(f"native lib unavailable: {native.unavailable_reason()}")
+    from music_analyst_tpu.models.tokenization import _wp_char_table
+
+    path = tmp_path_factory.mktemp("badvocab") / "vocab.txt"
+    path.write_text("just\nwords\n", encoding="utf-8")
+    assert native.wp_create(str(path), _wp_char_table()) is None

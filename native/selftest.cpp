@@ -31,5 +31,48 @@ int main(int argc, char** argv) {
               man_song_count(h), man_token_count(h), man_word_vocab_size(h),
               man_artist_vocab_size(h), threads);
   man_free(h);
+
+  // Threaded WordPiece batch under the same sanitizer: the vocab handle
+  // is shared read-only across workers; any write slipping in races.
+  {
+    const char vocab[] = "[PAD]\n[UNK]\n[CLS]\n[SEP]\n[MASK]\nlove\n##s\n";
+    // ASCII-only classes: ws / punct / word (full Unicode table is
+    // Python-built in production; class semantics are what's raced here).
+    unsigned char cls[128];
+    char repl[128];
+    int32_t offs[129];
+    for (int c = 0; c < 128; ++c) {
+      bool ws = c == ' ' || c == '\t' || c == '\n' || c == '\r';
+      bool punct = (c >= 33 && c <= 47) || (c >= 58 && c <= 64) ||
+                   (c >= 91 && c <= 96) || (c >= 123 && c <= 126);
+      cls[c] = ws ? 1 : (c < 32 || c == 127) ? 0 : punct ? 2 : 3;
+      repl[c] = (char)((c >= 'A' && c <= 'Z') ? c + 32 : c);
+      offs[c] = c;
+    }
+    offs[128] = 128;
+    void* wp = man_wp_create(vocab, (long long)sizeof(vocab) - 1, 100, cls,
+                             128, repl, offs);
+    if (!wp) {
+      std::fprintf(stderr, "wp_create failed\n");
+      return 1;
+    }
+    const int rows = 512, max_len = 16;
+    std::string blob;
+    std::vector<long long> offsets(rows + 1, 0);
+    for (int r = 0; r < rows; ++r) {
+      blob += "love loves [MASK] zzz! ";
+      offsets[r + 1] = (long long)blob.size();
+    }
+    std::vector<int32_t> out((size_t)rows * max_len);
+    std::vector<int32_t> lens(rows);
+    std::vector<unsigned char> handled(rows);
+    man_wp_encode_batch(wp, blob.data(), offsets.data(), rows, max_len,
+                        threads, out.data(), lens.data(), handled.data());
+    long long total = 0;
+    for (int r = 0; r < rows; ++r) total += lens[r];
+    std::printf("wp rows=%d total_ids=%lld handled=%d\n", rows, total,
+                (int)handled[0]);
+    man_wp_destroy(wp);
+  }
   return 0;
 }

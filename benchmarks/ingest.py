@@ -76,10 +76,61 @@ def run() -> dict:
         python_s = time.perf_counter() - start
         python_songs_per_s = oracle_songs / python_s
 
+    # Real-weights tokenization (MUSICAAL_BERT_VOCAB path): native Latin
+    # fast path vs the pure-Python WordPiece — the device forward runs
+    # ~9k songs/s, so the Python number is a real ceiling without the
+    # kernel.  Synthetic vocab from the corpus word stock (the throughput
+    # driver is the greedy subword search, not which ids come out).
+    from music_analyst_tpu.data.synthetic import _WORDS
+    from music_analyst_tpu.models.tokenization import (
+        NativeWordPieceTokenizer,
+        WordPieceTokenizer,
+    )
+
+    import numpy as np
+
+    rng = np.random.default_rng(3)
+    words = np.array(_WORDS)
+    wp_texts = [
+        " ".join(rng.choice(words, size=max(3, int(rng.normal(180, 60)))))
+        for _ in range(256 if smoke() else 4096)
+    ]
+    wp_python_rows = 64 if smoke() else 256
+    with tempfile.TemporaryDirectory() as tmp:
+        vocab_path = os.path.join(tmp, "vocab.txt")
+        vocab = (["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+                 + list(_WORDS)
+                 + ["##" + w[1:] for w in _WORDS if len(w) > 3])
+        vocab += [f"tok{i}" for i in range(30_000 - len(vocab))]
+        with open(vocab_path, "w", encoding="utf-8") as fh:
+            fh.write("\n".join(vocab))
+        nat_tok = NativeWordPieceTokenizer(vocab_path)
+        py_tok = WordPieceTokenizer(vocab_path)
+        if nat_tok._handle is not None:
+            nat_tok.encode_batch(wp_texts[:8], 128)  # warm
+            start = time.perf_counter()
+            nat_tok.encode_batch(wp_texts, 128)
+            nat_wp_s = time.perf_counter() - start
+        start = time.perf_counter()
+        py_tok.encode_batch(wp_texts[:wp_python_rows], 128)
+        py_wp_s = time.perf_counter() - start
+        wordpiece_row = {
+            "rows": len(wp_texts),
+            "python_songs_per_s": round(wp_python_rows / py_wp_s, 1),
+        }
+        if nat_tok._handle is not None:
+            wordpiece_row["native_songs_per_s"] = round(
+                len(wp_texts) / nat_wp_s, 1
+            )
+            wordpiece_row["speedup"] = round(
+                (len(wp_texts) / nat_wp_s) / (wp_python_rows / py_wp_s), 1
+            )
+
     out = {
         "suite": "ingest",
         "smoke": smoke(),
         "corpus": {"songs": n_songs, "mb": round(size_mb, 1)},
+        "wordpiece": wordpiece_row,
         "native": native_row,
         "python_oracle": {
             "songs": oracle_songs,

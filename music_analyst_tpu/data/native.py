@@ -252,7 +252,11 @@ def wp_encode_batch(handle: int, texts, max_len: int, num_threads: int = 0):
     lib = _load()
     if lib is None:
         raise RuntimeError(f"native library unavailable: {_load_error}")
-    encoded = [t.encode("utf-8", errors="replace") for t in texts]
+    # surrogatepass, NOT replace: a lone surrogate must reach the kernel
+    # as the invalid UTF-8 it is, so the row is flagged unhandled and the
+    # Python fallback (which drops it as a C*-category char) keeps the
+    # identical-output contract; "replace" would tokenize a synthetic '?'.
+    encoded = [t.encode("utf-8", errors="surrogatepass") for t in texts]
     offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
     np.cumsum([len(e) for e in encoded], out=offsets[1:])
     blob = b"".join(encoded)

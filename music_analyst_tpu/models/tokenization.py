@@ -462,9 +462,15 @@ class NativeWordPieceTokenizer(WordPieceTokenizer):
         return batch, lengths
 
     def __del__(self):
-        handle = getattr(self, "_handle", None)
-        if handle:
-            self._native.wp_destroy(handle)
+        try:
+            handle = getattr(self, "_handle", None)
+            if handle:
+                self._native.wp_destroy(handle)
+        except Exception:
+            # Interpreter teardown may have cleared module globals the
+            # destroy path needs; leaking at exit beats a stderr
+            # "Exception ignored" traceback in every process.
+            pass
 
 
 def resolve_bert_tokenizer(

@@ -129,7 +129,8 @@ def test_native_wordpiece_matches_python(pair, tmp_path_factory):
         "latin café naïve søster ßüber",      # table-handled, not fallback
         "the ελληνικά row",                   # Greek: per-row fallback
         "爱 love 愛",                          # CJK: per-row fallback
-    ]
+        "a\ud800b love",                      # lone surrogate: fallback,
+    ]                                          # Python drops it (C* char)
     for max_len in (8, 32):
         want_ids, want_lens = py.encode_batch(corpora, max_len)
         got_ids, got_lens = nat.encode_batch(corpora, max_len)

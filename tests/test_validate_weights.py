@@ -65,6 +65,25 @@ def test_validate_distilbert_full_agreement(fixture_csv, tmp_path,
     assert on_disk["agreement"] == 1.0
 
 
+def test_validate_covers_int8_and_packed_variants(fixture_csv, tmp_path,
+                                                  monkeypatch):
+    """The harness certifies the quantized and packed execution paths
+    against the same float oracle.  The x40 head scaling guards the
+    0.6-Neutral-threshold path (both sides commit); argmax stability
+    under the int8 perturbation comes from the seed-3 fixture's decisive
+    top-class margins (deterministic in CI — scaling is argmax-invariant
+    and does NOT protect near-ties, so a flip here means the quantized
+    path's perturbation grew past tests/test_quant.py's bound)."""
+    monkeypatch.setenv(
+        "MUSICAAL_DISTILBERT_CKPT", str(_distil_ckpt(tmp_path))
+    )
+    for model in ("distilbert-tiny-int8", "distilbert-tiny-packed"):
+        report = run_validation(
+            str(fixture_csv), model=model, quiet=True,
+        )
+        assert report["agreement"] == 1.0, (model, report["disagreements"])
+
+
 def test_validate_cli_gate(fixture_csv, tmp_path, monkeypatch):
     """The documented one-command path, including the CI gate flag."""
     monkeypatch.setenv(

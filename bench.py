@@ -105,6 +105,16 @@ def measure() -> dict:
     )
 
     enable_persistent_compilation_cache()
+    # Memory-only telemetry (no sink — this child's stdout is the one-line
+    # contract and its cwd is not a run directory): spans + jax compile
+    # listeners feed the payload's ``telemetry`` sub-object.
+    from music_analyst_tpu.telemetry import (
+        get_telemetry,
+        install_jax_listeners,
+    )
+
+    tel = get_telemetry()
+    install_jax_listeners()
     devices = jax.devices()
     n_chips = len(devices)
     platform = devices[0].platform
@@ -152,24 +162,28 @@ def measure() -> dict:
     batch = 8192  # measured best on v5e: ~10% over 4096 (amortizes dispatch)
 
     # Warmup: compile + first dispatch.
-    clf.classify_batch(texts[:batch])
+    with tel.span("warmup", rows=batch):
+        clf.classify_batch(texts[:batch])
 
     # One-deep host/device pipeline: tokenize batch i+1 while batch i runs.
     start = time.perf_counter()
     done = 0
     pending = None
-    while done < len(texts):
-        handle = clf.submit(texts[done : done + batch])
+    with tel.span("measure", rows=len(texts)):
+        while done < len(texts):
+            handle = clf.submit(texts[done : done + batch])
+            if pending is not None:
+                clf.collect(pending)
+            pending = handle
+            done += batch
         if pending is not None:
-            clf.collect(pending)
-        pending = handle
-        done += batch
-    if pending is not None:
-        clf.collect(pending)  # np.asarray readback — reliable on axon
+            clf.collect(pending)  # np.asarray readback — reliable on axon
     elapsed = time.perf_counter() - start
 
     songs_per_sec = len(texts) / elapsed
+    tel.count("rows_classified", len(texts))
     return {
+        "telemetry": tel.summary(top=3),
         "metric": METRIC,
         "value": round(songs_per_sec, 1),
         "unit": (

@@ -44,6 +44,15 @@ def _buckets_arg(text: str):
     return _int_list(text)
 
 
+def _add_telemetry_flags(p: argparse.ArgumentParser) -> None:
+    """Run-telemetry flags, shared by every subcommand (telemetry/)."""
+    p.add_argument("--telemetry-dir", default=None,
+                   help="Write telemetry.jsonl + run_manifest.json here "
+                        "(default: the run's output dir)")
+    p.add_argument("--no-telemetry", action="store_true",
+                   help="Disable run telemetry entirely (no extra files)")
+
+
 def _add_analyze(sub: argparse._SubParsersAction) -> None:
     p = sub.add_parser(
         "analyze",
@@ -79,6 +88,7 @@ def _add_analyze(sub: argparse._SubParsersAction) -> None:
                    help="Keyword-kernel sentiment for --with-sentiment")
     p.add_argument("--batch-size", type=int, default=4096,
                    help="Sentiment batch size for --with-sentiment")
+    _add_telemetry_flags(p)
 
 
 def _add_sentiment(sub: argparse._SubParsersAction) -> None:
@@ -108,6 +118,7 @@ def _add_sentiment(sub: argparse._SubParsersAction) -> None:
                         "32,64,128) or 'auto' to derive them from the "
                         "corpus; short songs run at shorter sequence "
                         "lengths")
+    _add_telemetry_flags(p)
 
 
 def _add_wordcount_per_song(sub: argparse._SubParsersAction) -> None:
@@ -121,6 +132,7 @@ def _add_wordcount_per_song(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--encoding", default="utf-8-sig")
     p.add_argument("--delimiter", default=None)
     p.add_argument("--workers", type=int, default=0)
+    _add_telemetry_flags(p)
 
 
 def _add_split(sub: argparse._SubParsersAction) -> None:
@@ -133,6 +145,7 @@ def _add_split(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--encoding", default="utf-8-sig")
     p.add_argument("--no-header", action="store_true")
     p.add_argument("--force", action="store_true")
+    _add_telemetry_flags(p)
 
 
 def _add_validate(sub: argparse._SubParsersAction) -> None:
@@ -152,6 +165,7 @@ def _add_validate(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--min-agreement", type=float, default=None,
                    help="Exit non-zero when agreement falls below this "
                         "fraction (CI gate)")
+    _add_telemetry_flags(p)
 
 
 def _add_sweep(sub: argparse._SubParsersAction) -> None:
@@ -164,6 +178,7 @@ def _add_sweep(sub: argparse._SubParsersAction) -> None:
                    help="Comma-separated device counts (default: 1,2,4,8 capped)")
     p.add_argument("--output-dir", default="output")
     p.add_argument("--ingest", choices=("auto", "native", "python"), default="auto")
+    _add_telemetry_flags(p)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -179,6 +194,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_sweep(sub)
     _add_validate(sub)
     args = parser.parse_args(argv)
+
+    from music_analyst_tpu.telemetry import configure
+
+    configure(
+        enabled=not args.no_telemetry, directory=args.telemetry_dir
+    )
 
     if args.command == "validate":
         from music_analyst_tpu.engines.validate import run_validation
@@ -307,16 +328,21 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.command == "split":
         from music_analyst_tpu.data.splitter import split_csv_columns
+        from music_analyst_tpu.telemetry import get_telemetry
 
-        out_dir, names = split_csv_columns(
-            args.csv_path,
-            output_dir=args.output_dir,
-            delimiter=args.delimiter,
-            quotechar=args.quotechar,
-            encoding=args.encoding,
-            no_header=args.no_header,
-            force=args.force,
-        )
+        # The splitter has no engine scope of its own; sink only where
+        # --telemetry-dir points (None ⇒ memory-only), never into the
+        # split output dir — its listing is a compared artifact.
+        with get_telemetry().run_scope("split", None):
+            out_dir, names = split_csv_columns(
+                args.csv_path,
+                output_dir=args.output_dir,
+                delimiter=args.delimiter,
+                quotechar=args.quotechar,
+                encoding=args.encoding,
+                no_header=args.no_header,
+                force=args.force,
+            )
         print(f"Wrote {len(names)} column file(s) to {out_dir}:")
         for name in names:
             print(f"  {out_dir / name}")

@@ -336,15 +336,25 @@ def ingest_native(
     from music_analyst_tpu.data.ingest import IngestResult
     from music_analyst_tpu.data.vocab import Vocab
 
+    from music_analyst_tpu.telemetry import get_telemetry
+
     lib = _load()
     if lib is None:
         raise RuntimeError(f"native library unavailable: {_load_error}")
-    handle = lib.man_ingest_v2(
-        path.encode("utf-8"),
-        ctypes.c_longlong(-1 if limit is None else limit),
-        ctypes.c_int(num_threads),
-        ctypes.c_int(1 if capture_records else 0),
-    )
+    tel = get_telemetry()
+    try:
+        file_bytes = os.path.getsize(path)
+    except OSError:
+        file_bytes = 0
+    # The span times the C++ parse only — the native boundary the run log
+    # wants isolated; the numpy copy-out below is host-side glue.
+    with tel.span("native_ingest", bytes=file_bytes):
+        handle = lib.man_ingest_v2(
+            path.encode("utf-8"),
+            ctypes.c_longlong(-1 if limit is None else limit),
+            ctypes.c_int(num_threads),
+            ctypes.c_int(1 if capture_records else 0),
+        )
     if not handle:
         raise RuntimeError("native ingest failed to allocate")
     try:
@@ -353,6 +363,9 @@ def ingest_native(
             raise RuntimeError(f"native ingest: {err.decode()}")
         songs = lib.man_song_count(handle)
         tokens = lib.man_token_count(handle)
+        tel.count("native_bytes_parsed", file_bytes)
+        tel.count("native_songs_parsed", int(songs))
+        tel.count("native_tokens_parsed", int(tokens))
         word_ids = np.empty(tokens, dtype=np.int32)
         word_offsets = np.empty(songs + 1, dtype=np.int64)
         artist_ids = np.empty(songs, dtype=np.int32)

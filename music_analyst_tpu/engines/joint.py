@@ -52,6 +52,22 @@ def run_joint(
     ingest_backend: str = "auto",
     quiet: bool = False,
 ) -> JointResult:
+    from music_analyst_tpu.telemetry import get_telemetry
+
+    tel = get_telemetry()
+    # Owner scope: the nested wordcount/sentiment engines' run scopes
+    # degrade to spans under this one — ONE manifest for the fused run.
+    with tel.run_scope("joint", output_dir):
+        return _run_joint_impl(
+            dataset_path, output_dir, model, mock, word_limit, artist_limit,
+            limit, batch_size, mesh, write_split, ingest_backend, quiet,
+        )
+
+
+def _run_joint_impl(
+    dataset_path, output_dir, model, mock, word_limit, artist_limit,
+    limit, batch_size, mesh, write_split, ingest_backend, quiet,
+) -> JointResult:
     timer = StageTimer()
     with timer.stage("ingest"):
         corpus = ingest_dataset(

@@ -30,6 +30,7 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 from music_analyst_tpu.data.csv_io import sniff_delimiter
 from music_analyst_tpu.data.tokenizer import tokenize_latin1
+from music_analyst_tpu.telemetry import get_telemetry
 
 # Rows per pool task.  Large enough to amortize future/queue overhead,
 # small enough that the bounded window keeps memory flat on 1M-row files.
@@ -81,12 +82,20 @@ def _tokenize_chunk(
     Per-song word order is first-appearance order (dict insertion), which
     both artifacts expose and the differential tests pin.
     """
+    import time
+
+    start = time.perf_counter()
     out: List[_SongCounts] = []
     for artist, song, text in rows:
         per_song: Dict[str, int] = {}
         for token in tokenize_latin1(text):
             per_song[token] = per_song.get(token, 0) + 1
         out.append((artist, song, tuple(per_song.items())) if per_song else None)
+    # Recorded from the pool worker thread — the registry's span path is
+    # thread-safe by contract (tests/test_telemetry.py pins it).
+    get_telemetry().record_span(
+        "tokenize", time.perf_counter() - start, rows=len(rows)
+    )
     return out
 
 
@@ -138,7 +147,33 @@ def run_per_song_wordcount(
     histogram = _DenseHistogram()
     total_rows = 0
 
-    with open(src, "r", encoding=encoding, newline="") as fh:
+    tel = get_telemetry()
+    with tel.run_scope("persong", str(out)):
+        total_rows = _persong_stream(
+            src, per_song_path, global_path, encoding, delimiter,
+            n_workers, histogram, tel,
+        )
+        tel.count("rows_processed", total_rows)
+        tel.count("distinct_words", len(histogram.counts))
+        tel.count("words_counted", histogram.total)
+
+    if not quiet:
+        print(
+            f"Processed {total_rows} row(s); "
+            f"{len(histogram.counts)} distinct words, {histogram.total} total."
+        )
+        print(f"  global ranking: {global_path}")
+        print(f"  per-song rows:  {per_song_path}")
+    return global_path, per_song_path, total_rows
+
+
+def _persong_stream(
+    src, per_song_path, global_path, encoding, delimiter, n_workers,
+    histogram, tel,
+) -> int:
+    total_rows = 0
+    with tel.span("ingest", workers=n_workers), \
+            open(src, "r", encoding=encoding, newline="") as fh:
         delim = delimiter or sniff_delimiter(fh.read(65536))
         fh.seek(0)
         reader = csv.DictReader(fh, delimiter=delim)
@@ -174,16 +209,10 @@ def run_per_song_wordcount(
             while window:
                 fold(window.popleft().result())
 
-    with open(global_path, "w", encoding="utf-8", newline="") as g_fh:
+    with tel.span("write", rows=total_rows), \
+            open(global_path, "w", encoding="utf-8", newline="") as g_fh:
         ranked = csv.writer(g_fh)
         ranked.writerow(["word", "count"])
         ranked.writerows(histogram.ranked())
 
-    if not quiet:
-        print(
-            f"Processed {total_rows} row(s); "
-            f"{len(histogram.counts)} distinct words, {histogram.total} total."
-        )
-        print(f"  global ranking: {global_path}")
-        print(f"  per-song rows:  {per_song_path}")
-    return global_path, per_song_path, total_rows
+    return total_rows

@@ -24,6 +24,7 @@ import time
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from music_analyst_tpu.data.csv_io import iter_songs
+from music_analyst_tpu.telemetry import get_telemetry
 from music_analyst_tpu.utils.labels import SUPPORTED_LABELS
 
 
@@ -249,6 +250,37 @@ def run_sentiment(
         # silently misattribute rows.  Checked before any output file is
         # touched.
         raise ValueError("resume=True cannot be combined with songs=")
+    tel = get_telemetry()
+    with tel.run_scope("sentiment", output_dir):
+        return _run_sentiment_impl(
+            tel, dataset_path, model, mock, limit, output_dir, batch_size,
+            backend, quiet, resume, songs, mesh, length_buckets,
+        )
+
+
+def _timed_source(tel, source):
+    """Yield rows from ``source`` while accumulating pure read time; the
+    total lands as ONE ``ingest`` span (per-row spans would swamp the log
+    on million-row datasets)."""
+    read_s = 0.0
+    n = 0
+    it = iter(source)
+    while True:
+        t0 = time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            break
+        read_s += time.perf_counter() - t0
+        n += 1
+        yield item
+    tel.record_span("ingest", read_s, rows=n)
+
+
+def _run_sentiment_impl(
+    tel, dataset_path, model, mock, limit, output_dir, batch_size,
+    backend, quiet, resume, songs, mesh, length_buckets,
+) -> SentimentResult:
     os.makedirs(output_dir, exist_ok=True)
     if backend is None:
         # Every built-in backend compiles device programs (the mock path
@@ -270,9 +302,11 @@ def run_sentiment(
             )
         clf = backend
     else:
-        clf = get_backend(
-            model, mock=mock, mesh=mesh, length_buckets=length_buckets
-        )
+        with tel.span("backend_init", model=model, mock=bool(mock)):
+            clf = get_backend(
+                model, mock=mock, mesh=mesh, length_buckets=length_buckets
+            )
+    tel.annotate(backend=clf.name, batch_size=batch_size)
 
     totals_path = os.path.join(output_dir, "sentiment_totals.json")
     details_path = os.path.join(output_dir, "sentiment_details.csv")
@@ -301,32 +335,38 @@ def run_sentiment(
     in_flight: Optional[Tuple[List[Tuple[str, str, str]], Any, float]] = None
 
     def finish(rows_batch, handle, t_submit, measured) -> None:
-        labels = clf.collect(handle)
+        with tel.span("compute", rows=len(rows_batch)):
+            labels = clf.collect(handle)
         elapsed = time.perf_counter() - t_submit
+        # Submit→collect wall time per batch — the batched analogue of the
+        # reference's per-song HTTP latency column.
+        tel.observe("sentiment.batch_seconds", elapsed)
+        tel.count("rows_classified", len(rows_batch))
         # Per-song latency: exact when the backend measures it (Ollama
         # passthrough), amortized batch time for device backends, 0.0 for
         # mock — matching the reference's per-row semantics.
         per_song = (
             elapsed / max(1, len(rows_batch)) if clf.reports_latency else 0.0
         )
-        for i, ((artist, song, text), label) in enumerate(
-            zip(rows_batch, labels)
-        ):
-            if measured and len(measured) == len(rows_batch):
-                latency = measured[i]
-            else:
-                latency = 0.0 if not text.strip() else per_song
-            counts[label] += 1
-            rows.append(SentimentRow(artist, song, label, latency))
-            writer.writerow(
-                {
-                    "artist": artist,
-                    "song": song,
-                    "label": label,
-                    "latency_seconds": f"{latency:.4f}",
-                }
-            )
-        details_fh.flush()
+        with tel.span("write", rows=len(rows_batch)):
+            for i, ((artist, song, text), label) in enumerate(
+                zip(rows_batch, labels)
+            ):
+                if measured and len(measured) == len(rows_batch):
+                    latency = measured[i]
+                else:
+                    latency = 0.0 if not text.strip() else per_song
+                counts[label] += 1
+                rows.append(SentimentRow(artist, song, label, latency))
+                writer.writerow(
+                    {
+                        "artist": artist,
+                        "song": song,
+                        "label": label,
+                        "latency_seconds": f"{latency:.4f}",
+                    }
+                )
+            details_fh.flush()
 
     def flush() -> None:
         nonlocal in_flight, batch
@@ -334,7 +374,10 @@ def run_sentiment(
             return
         texts = [text for _, _, text in batch]
         t0 = time.perf_counter()
-        handle = clf.submit(texts)
+        # "tokenize": the host half of submit() (tokenization + dispatch);
+        # device time is the async tail collected under "compute".
+        with tel.span("tokenize", rows=len(texts)):
+            handle = clf.submit(texts)
         # Snapshot measured latencies NOW: synchronous backends (Ollama)
         # classify inside submit() and overwrite last_latencies on the
         # next submit, which would mis-attribute them across batches.
@@ -345,8 +388,9 @@ def run_sentiment(
             finish(*in_flight)
         in_flight = pending
 
-    source = (
-        songs if songs is not None else iter_songs(dataset_path, limit=limit)
+    source = _timed_source(
+        tel,
+        songs if songs is not None else iter_songs(dataset_path, limit=limit),
     )
     try:
         for idx, (artist, song, text) in enumerate(source):

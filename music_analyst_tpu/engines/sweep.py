@@ -29,11 +29,30 @@ def run_sweep(
     ingest_backend: str = "auto",
     quiet: bool = True,
 ) -> dict:
+    from music_analyst_tpu.telemetry import get_telemetry
+
+    tel = get_telemetry()
     os.makedirs(output_dir, exist_ok=True)
     n_available = len(jax.devices())
     if device_counts is None:
         device_counts = [n for n in (1, 2, 4, 8) if n <= n_available]
     summary: dict = {"dataset": dataset_path, "runs": []}
+    with tel.run_scope("sweep", output_dir):
+        _sweep_points(
+            tel, summary, dataset_path, device_counts, n_available,
+            output_dir, ingest_backend, quiet,
+        )
+    summary_path = os.path.join(output_dir, "sweep_summary.json")
+    with open(summary_path, "w", encoding="utf-8") as fh:
+        json.dump(summary, fh, indent=2)
+        fh.write("\n")
+    return summary
+
+
+def _sweep_points(
+    tel, summary, dataset_path, device_counts, n_available, output_dir,
+    ingest_backend, quiet,
+) -> None:
     base_wall = None
     for n in device_counts:
         if n > n_available:
@@ -41,15 +60,17 @@ def run_sweep(
             continue
         mesh = data_parallel_mesh(n)
         start = time.perf_counter()
-        run_analysis(
-            dataset_path,
-            output_dir=output_dir,
-            mesh=mesh,
-            write_split=(n == device_counts[0]),  # split artifacts once
-            ingest_backend=ingest_backend,
-            quiet=quiet,
-        )
+        with tel.span("sweep_point", devices=n):
+            run_analysis(
+                dataset_path,
+                output_dir=output_dir,
+                mesh=mesh,
+                write_split=(n == device_counts[0]),  # split artifacts once
+                ingest_backend=ingest_backend,
+                quiet=quiet,
+            )
         wall = time.perf_counter() - start
+        tel.count("sweep_points")
         # Archive this point's metrics (the reference overwrites them).
         src = os.path.join(output_dir, "performance_metrics.json")
         dst = os.path.join(output_dir, f"performance_metrics_np{n}.json")
@@ -66,8 +87,3 @@ def run_sweep(
         )
         if not quiet:
             print(f"np={n}: {wall:.3f}s")
-    summary_path = os.path.join(output_dir, "sweep_summary.json")
-    with open(summary_path, "w", encoding="utf-8") as fh:
-        json.dump(summary, fh, indent=2)
-        fh.write("\n")
-    return summary

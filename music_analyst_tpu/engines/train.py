@@ -187,6 +187,30 @@ def init_train_state(
     )
 
 
+def _with_step_telemetry(step):
+    """Wrap a (possibly jitted) train step with a telemetry span + counter.
+
+    The span measures *dispatch* time: the jitted program is asynchronous,
+    so the first call's duration includes trace+compile while steady-state
+    calls are near-instant enqueues.  That asymmetry is exactly what makes
+    the span useful — compile stalls show up as outlier ``train_step``
+    spans next to the jax backend_compile events in the same log.
+    """
+    import functools
+
+    from music_analyst_tpu.telemetry import get_telemetry
+
+    @functools.wraps(step)
+    def timed_step(state, token_ids, lengths, segment_ids=None):
+        tel = get_telemetry()
+        with tel.span("train_step"):
+            out = step(state, token_ids, lengths, segment_ids)
+        tel.count("train_steps")
+        return out
+
+    return timed_step
+
+
 def make_train_step(model, optimizer, mesh: Optional[Mesh] = None):
     """Build the jitted SPMD train step.
 
@@ -213,7 +237,7 @@ def make_train_step(model, optimizer, mesh: Optional[Mesh] = None):
         )
 
     if mesh is None:
-        return jax.jit(step_fn)
+        return _with_step_telemetry(jax.jit(step_fn))
 
     data_axes = [a for a in ("dp", "sp") if a in mesh.axis_names]
     dp = data_axes[0] if data_axes else None
@@ -275,4 +299,4 @@ def make_train_step(model, optimizer, mesh: Optional[Mesh] = None):
         last_out[0], last_out[1] = weakref.ref(new_state), jitted
         return new_state, loss
 
-    return pinned_step
+    return _with_step_telemetry(pinned_step)

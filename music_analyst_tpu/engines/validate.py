@@ -242,51 +242,60 @@ def run_validation(
             "weights would certify nothing"
         )
 
-    songs = []
-    for artist, song, text in iter_songs(dataset_path):
-        songs.append((artist, song, text))
-        if limit and len(songs) >= limit:
-            break
-    texts = [text for _, _, text in songs]
+    from music_analyst_tpu.telemetry import get_telemetry
 
-    ours = clf.classify_batch(texts)
-    oracle = (
-        _oracle_distilbert_labels(checkpoint_path, clf, texts)
-        if family == "distilbert"
-        else _oracle_llama_labels(checkpoint_path, clf, texts)
-    )
+    tel = get_telemetry()
+    with tel.run_scope("validate", output_dir):
+        tel.annotate(model=model, backend=getattr(clf, "name", model))
+        with tel.span("ingest"):
+            songs = []
+            for artist, song, text in iter_songs(dataset_path):
+                songs.append((artist, song, text))
+                if limit and len(songs) >= limit:
+                    break
+            texts = [text for _, _, text in songs]
+        tel.count("rows_validated", len(texts))
 
-    disagreements = [
-        {"artist": a, "song": s, "ours": o, "oracle": h}
-        for (a, s, _), o, h in zip(songs, ours, oracle)
-        if o != h
-    ]
-    confusion = {
-        want: {got: 0 for got in SUPPORTED_LABELS}
-        for want in SUPPORTED_LABELS
-    }
-    for o, h in zip(ours, oracle):
-        confusion[h][o] += 1
-    report = {
-        "model": model,
-        "checkpoint": checkpoint_path,
-        "rows": len(texts),
-        # Unrounded: the CLI --min-agreement gate compares this value, and
-        # rounding could nudge a just-failing run over the bar.
-        "agreement": sum(
-            o == h for o, h in zip(ours, oracle)
-        ) / max(1, len(texts)),
-        "oracle": "transformers torch forward, shared tokenizer ids",
-        "confusion_oracle_to_ours": confusion,
-        "disagreements": disagreements[:20],
-    }
-    if output_dir:
-        os.makedirs(output_dir, exist_ok=True)
-        path = os.path.join(output_dir, "weight_validation.json")
-        with open(path, "w", encoding="utf-8") as fh:
-            json.dump(report, fh, indent=2)
-        if not quiet:
-            print(f"Validation report -> {path}")
+        with tel.span("compute", rows=len(texts)):
+            ours = clf.classify_batch(texts)
+        with tel.span("oracle", rows=len(texts)):
+            oracle = (
+                _oracle_distilbert_labels(checkpoint_path, clf, texts)
+                if family == "distilbert"
+                else _oracle_llama_labels(checkpoint_path, clf, texts)
+            )
+
+        disagreements = [
+            {"artist": a, "song": s, "ours": o, "oracle": h}
+            for (a, s, _), o, h in zip(songs, ours, oracle)
+            if o != h
+        ]
+        confusion = {
+            want: {got: 0 for got in SUPPORTED_LABELS}
+            for want in SUPPORTED_LABELS
+        }
+        for o, h in zip(ours, oracle):
+            confusion[h][o] += 1
+        report = {
+            "model": model,
+            "checkpoint": checkpoint_path,
+            "rows": len(texts),
+            # Unrounded: the CLI --min-agreement gate compares this value,
+            # and rounding could nudge a just-failing run over the bar.
+            "agreement": sum(
+                o == h for o, h in zip(ours, oracle)
+            ) / max(1, len(texts)),
+            "oracle": "transformers torch forward, shared tokenizer ids",
+            "confusion_oracle_to_ours": confusion,
+            "disagreements": disagreements[:20],
+        }
+        if output_dir:
+            os.makedirs(output_dir, exist_ok=True)
+            path = os.path.join(output_dir, "weight_validation.json")
+            with tel.span("write"), open(path, "w", encoding="utf-8") as fh:
+                json.dump(report, fh, indent=2)
+            if not quiet:
+                print(f"Validation report -> {path}")
     if not quiet:
         print(
             f"{report['rows']} rows: {report['agreement'] * 100:.1f}% label "

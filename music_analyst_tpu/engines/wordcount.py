@@ -74,15 +74,30 @@ def run_analysis(
     the caller's measured ingest time, folded into the timing stats exactly
     as an in-engine ingest would be.
     """
+    from music_analyst_tpu.telemetry import get_telemetry
     from music_analyst_tpu.utils.cache import (
         enable_persistent_compilation_cache,
     )
 
     enable_persistent_compilation_cache()
+    tel = get_telemetry()
     timer = StageTimer()
     os.makedirs(output_dir, exist_ok=True)
     split_dir = os.path.join(output_dir, "split_columns")
 
+    with tel.run_scope("wordcount", output_dir):
+        return _run_analysis_instrumented(
+            tel, timer, dataset_path, output_dir, split_dir, word_limit,
+            artist_limit, limit, mesh, write_split, ingest_backend,
+            count_mode, quiet, corpus, ingest_seconds,
+        )
+
+
+def _run_analysis_instrumented(
+    tel, timer, dataset_path, output_dir, split_dir, word_limit,
+    artist_limit, limit, mesh, write_split, ingest_backend, count_mode,
+    quiet, corpus, ingest_seconds,
+) -> AnalysisResult:
     with timer.stage("split"):
         if write_split:
             artist_label, text_label = read_header_labels(dataset_path)
@@ -107,6 +122,15 @@ def run_analysis(
         mesh = data_parallel_mesh()
 
     n_chips = mesh.devices.size
+    tel.count("songs_ingested", corpus.song_count)
+    tel.count("words_counted", corpus.token_count)
+    tel.annotate(
+        mesh_shape={
+            name: int(size)
+            for name, size in zip(mesh.axis_names, mesh.devices.shape)
+        },
+        count_mode=count_mode,
+    )
     with timer.stage("device_compute"):
         # np.asarray is the synchronization point: block_until_ready is not
         # reliable on every PJRT plugin, and the engine needs the host

@@ -16,16 +16,27 @@ from typing import Dict, Iterator
 
 
 class StageTimer:
-    """Accumulates named wall-clock stage durations."""
+    """Accumulates named wall-clock stage durations.
+
+    Also a telemetry span adapter: every stage opens a same-named span on
+    the process registry (``music_analyst_tpu/telemetry``), so engines
+    keep one timing call-site and the JSONL event log sees the stage
+    hierarchy for free.  ``self.seconds`` stays the sole source for
+    ``performance_metrics.json`` — its keys and accumulation semantics are
+    byte-stable whether telemetry is enabled or not.
+    """
 
     def __init__(self) -> None:
         self.seconds: Dict[str, float] = {}
 
     @contextmanager
     def stage(self, name: str) -> Iterator[None]:
+        from music_analyst_tpu.telemetry import get_telemetry
+
         start = time.perf_counter()
         try:
-            yield
+            with get_telemetry().span(name):
+                yield
         finally:
             self.seconds[name] = self.seconds.get(name, 0.0) + (
                 time.perf_counter() - start

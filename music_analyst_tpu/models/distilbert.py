@@ -485,6 +485,11 @@ class DistilBertClassifier(ClassifierBackend):
         # config vocab, and an int16 wire would silently wrap its ids.
         wire_vocab = max(self.config.vocab_size, self.tokenizer.vocab_size)
         self._wire_dtype = np.int16 if wire_vocab <= (1 << 15) else np.int32
+        # Packed-row segment starts / row lengths are positions in
+        # [0, max_len] (max_len itself is the empty-slot sentinel), so the
+        # same wire-narrowing applies — conditioned on max_len, not the
+        # vocab: a long-context config must not wrap its offsets.
+        self._index_dtype = np.int16 if max_len < (1 << 15) else np.int32
 
     @classmethod
     def from_pretrained_or_random(cls, model: str, **kwargs):
@@ -598,8 +603,8 @@ class DistilBertClassifier(ClassifierBackend):
                 i, : lengths[i]
             ]
         ids = np.asarray(ids, dtype=self._wire_dtype)
-        st = np.asarray(st, dtype=np.int16)
-        rl = np.asarray(rl, dtype=np.int16)
+        st = np.asarray(st, dtype=self._index_dtype)
+        rl = np.asarray(rl, dtype=self._index_dtype)
         if self._data_sharding is not None:
             ids = jax.device_put(ids, self._data_sharding)
             st = jax.device_put(st, self._data_sharding)

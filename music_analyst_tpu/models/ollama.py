@@ -18,6 +18,7 @@ from typing import List, Sequence
 
 from music_analyst_tpu.engines.sentiment import ClassifierBackend
 from music_analyst_tpu.models.llama import LYRICS_TRUNCATION, PROMPT_TEMPLATE
+from music_analyst_tpu.telemetry import get_telemetry
 from music_analyst_tpu.utils.labels import normalise_label
 
 DEFAULT_ENDPOINT = "http://localhost:11434"
@@ -78,6 +79,7 @@ class OllamaClassifier(ClassifierBackend):
                 elapsed = time.perf_counter() - start
                 response.raise_for_status()
                 raw_output = response.json().get("response", "").strip()
+                get_telemetry().observe("ollama.request_seconds", elapsed)
                 return normalise_label(raw_output), elapsed
             except requests.RequestException as exc:
                 status = getattr(
@@ -90,6 +92,7 @@ class OllamaClassifier(ClassifierBackend):
                     raise
                 last_exc = exc
                 if attempt < self.retries:
+                    get_telemetry().count("http_retries")
                     time.sleep(self.backoff_seconds * (2 ** attempt))
         assert last_exc is not None
         raise last_exc
@@ -97,8 +100,9 @@ class OllamaClassifier(ClassifierBackend):
     def classify_batch(self, texts: Sequence[str]) -> List[str]:
         labels: List[str] = []
         self.last_latencies = []
-        for text in texts:
-            label, latency = self._classify_one(text)
-            labels.append(label)
-            self.last_latencies.append(latency)
+        with get_telemetry().span("ollama_batch", rows=len(texts)):
+            for text in texts:
+                label, latency = self._classify_one(text)
+                labels.append(label)
+                self.last_latencies.append(latency)
         return labels

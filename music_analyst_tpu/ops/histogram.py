@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from music_analyst_tpu.utils.jax_compat import shard_map
 from music_analyst_tpu.utils.shapes import round_pow2
 
 PAD_ID = -1
@@ -75,7 +76,7 @@ def _bucket_linear(n: int, step: int) -> int:
 # --- compiled-collective cache -------------------------------------------
 #
 # The shard_map callables below are built once per (mesh, axis[, vocab]) and
-# memoized: constructing ``jax.jit(jax.shard_map(lambda ...))`` inside every
+# memoized: constructing ``jax.jit(shard_map(lambda ...))`` inside every
 # call would miss jit's own cache on every invocation (fresh lambda
 # identity) and re-trace — which made sweep wall-times compilation-bound
 # rather than scaling-meaningful.  ``Mesh`` is hashable by (devices, axis
@@ -88,7 +89,7 @@ def _psum_ids_histogram(mesh: Mesh, axis: str, padded_vocab: int):
         return jax.lax.psum(token_histogram(x, padded_vocab), axis)
 
     return jax.jit(
-        jax.shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P())
+        shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P())
     )
 
 
@@ -98,7 +99,7 @@ def _psum_rows(mesh: Mesh, axis: str):
         return jax.lax.psum(h[0], axis)
 
     return jax.jit(
-        jax.shard_map(local, mesh=mesh, in_specs=P(axis, None), out_specs=P())
+        shard_map(local, mesh=mesh, in_specs=P(axis, None), out_specs=P())
     )
 
 
@@ -108,7 +109,7 @@ def _psum_scalar(mesh: Mesh, axis: str):
         return jax.lax.psum(jnp.sum(x), axis)
 
     return jax.jit(
-        jax.shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P())
+        shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P())
     )
 
 

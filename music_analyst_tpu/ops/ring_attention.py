@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from music_analyst_tpu.utils.jax_compat import pcast, shard_map
+
 _NEG_INF = -1e30
 
 
@@ -88,7 +90,7 @@ def ring_attention_local(q, k, v, segment_ids=None, *, axis_name: str,
     o = jnp.zeros((B, H, S_loc, D), jnp.float32)
     # The accumulators become device-varying inside the ring loop; mark the
     # initial values as varying over the axis so the carry types line up.
-    m, l, o = (jax.lax.pcast(x, (axis_name,), to="varying") for x in (m, l, o))
+    m, l, o = (pcast(x, (axis_name,), to="varying") for x in (m, l, o))
 
     segmented = segment_ids is not None
     # This device's own (query-side) segment shard never rotates; only the
@@ -178,7 +180,7 @@ def ring_attention(
                    use_flash=use_flash)
     n_in = 3 if segment_ids is None else 4
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             body,
             mesh=mesh,
             in_specs=(P(None, axis),) * n_in,

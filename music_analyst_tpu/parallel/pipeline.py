@@ -23,6 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from music_analyst_tpu.utils.jax_compat import pcast, shard_map
+
 
 def stack_layer_params(params: dict, n_stages: int, prefix: str = "layer_"):
     """``{layer_0: t0, layer_1: t1, ...}`` → stacked ``[n_stages, k, ...]``.
@@ -93,9 +95,9 @@ def pipeline_apply(
         n_micro = mb.shape[0]
         ticks = n_micro + n - 1
         state = jnp.zeros_like(mb[0])
-        state = jax.lax.pcast(state, (axis,), to="varying")
+        state = pcast(state, (axis,), to="varying")
         outputs = jnp.zeros_like(mb)
-        outputs = jax.lax.pcast(outputs, (axis,), to="varying")
+        outputs = pcast(outputs, (axis,), to="varying")
         perm = [(i, (i + 1) % n) for i in range(n)]
 
         def tick(carry, t):
@@ -122,7 +124,7 @@ def pipeline_apply(
         )
         return outputs
 
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis), P()),

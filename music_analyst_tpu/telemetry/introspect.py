@@ -1,0 +1,162 @@
+"""Device/compile introspection + the run-manifest sink.
+
+Everything here may import jax — it runs at run-scope exit or inside
+``bench.py``'s measurement child, never at package import time (the test
+harness must force ``JAX_PLATFORMS=cpu`` before the first jax import,
+``tests/conftest.py``).
+
+Compile visibility comes from ``jax.monitoring``: jax times every trace /
+MLIR-lowering / backend-compile under ``/jax/core/compile/*_duration``
+events (``jax/_src/dispatch.py``), and the persistent-compilation-cache
+hit/miss counters ride the same bus.  One listener pair routes them into
+the process registry; the manifest then reports XLA compile count/seconds
+per run without wrapping any jax API.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import Any, Dict, List, Optional
+
+from music_analyst_tpu.telemetry.core import Telemetry, get_telemetry
+
+_LISTENERS_INSTALLED = False
+_GIT_DESCRIBE: Optional[str] = None
+_GIT_PROBED = False
+
+
+def install_jax_listeners() -> bool:
+    """Route ``jax.monitoring`` events into the process registry.
+
+    Idempotent; jax offers no per-listener deregistration, so the
+    callbacks stay for the process lifetime and route to whatever the
+    registry's current run is (disabled registries drop them).
+    """
+    global _LISTENERS_INSTALLED
+    if _LISTENERS_INSTALLED:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:  # pragma: no cover - jax always present in-repo
+        return False
+
+    def _on_event(event: str, **kwargs: Any) -> None:
+        get_telemetry().record_jax_event(event)
+
+    def _on_duration(event: str, duration: float, **kwargs: Any) -> None:
+        get_telemetry().record_jax_event(event, duration)
+
+    monitoring.register_event_listener(_on_event)
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    _LISTENERS_INSTALLED = True
+    return True
+
+
+def git_describe() -> Optional[str]:
+    """``git describe --always --dirty`` of the repo, cached per process."""
+    global _GIT_DESCRIBE, _GIT_PROBED
+    if _GIT_PROBED:
+        return _GIT_DESCRIBE
+    _GIT_PROBED = True
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    try:
+        out = subprocess.run(
+            ["git", "describe", "--always", "--dirty"],
+            cwd=repo_root, capture_output=True, text=True, timeout=10,
+        )
+        if out.returncode == 0:
+            _GIT_DESCRIBE = out.stdout.strip() or None
+    except Exception:
+        _GIT_DESCRIBE = None
+    return _GIT_DESCRIBE
+
+
+def peak_rss_bytes() -> Optional[int]:
+    try:
+        import resource
+
+        # Linux reports ru_maxrss in KiB.
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:  # pragma: no cover - non-POSIX
+        return None
+
+
+def collect_device_info() -> Dict[str, Any]:
+    """Platform, device count, and per-device ``memory_stats()`` where the
+    plugin exposes them (TPU does; CPU-emulated meshes return None)."""
+    import jax
+
+    devices = jax.devices()
+    per_device: List[Optional[Dict[str, Any]]] = []
+    for d in devices:
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        per_device.append(stats)
+    return {
+        "platform": devices[0].platform if devices else "unknown",
+        "count": len(devices),
+        "kinds": sorted({d.device_kind for d in devices}),
+        "memory_stats": per_device,
+    }
+
+
+def write_run_manifest(
+    tel: Telemetry, directory: str, wall_seconds: float = 0.0
+) -> str:
+    """Write ``<directory>/run_manifest.json`` from the registry's state.
+
+    The manifest is the one-glance answer to "what ran, where, and what
+    did it cost": CLI argv, device platform/count/memory, mesh shape (when
+    an engine annotated one), jax/jaxlib versions, git describe, peak RSS,
+    XLA compile count/seconds, and the final counter/gauge/histogram/span
+    aggregates.
+    """
+    import jax
+    import jaxlib
+
+    install_jax_listeners()
+    with tel._lock:
+        context = dict(tel.context)
+        counters = dict(tel.counters)
+        gauges = dict(tel.gauges)
+        histograms = {k: h.as_dict() for k, h in tel.histograms.items()}
+        jax_events = {
+            k: {"count": int(n), "seconds": round(t, 6)}
+            for k, (n, t) in sorted(tel.jax_events.items())
+        }
+        events = tel.events
+    manifest: Dict[str, Any] = {
+        "schema": 1,
+        "engine": context.pop("engine", None),
+        "argv": list(sys.argv[1:]),
+        "wall_seconds": round(wall_seconds, 6),
+        "python_version": sys.version.split()[0],
+        "jax_version": jax.__version__,
+        "jaxlib_version": jaxlib.__version__,
+        "git_describe": git_describe(),
+        "device": collect_device_info(),
+        "peak_rss_bytes": peak_rss_bytes(),
+        "compile": tel.compile_stats(),
+        "jax_events": jax_events,
+        "context": context,
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": histograms,
+        "spans": tel.top_spans(n=20),
+        "event_count": events,
+        "telemetry_log": tel.sink_path,
+    }
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, "run_manifest.json")
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(manifest, fh, indent=2, default=str)
+        fh.write("\n")
+    return path

@@ -1,0 +1,35 @@
+"""Version shims for jax API moves.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+top-level ``jax.shard_map`` in jax 0.5, and its replication-check kwarg
+was renamed ``check_rep`` → ``check_vma``.  ``jax.lax.pcast`` arrived
+with the varying-manual-axes type system; under the older ``check_rep``
+system there is nothing to cast, so it degrades to identity.  The
+kernels here are written against the new names; this shim keeps them
+running on a 0.4.x jax.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    from jax import shard_map  # jax >= 0.5
+except ImportError:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _experimental
+
+    def shard_map(f, /, **kwargs):  # type: ignore[misc]
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _experimental(f, **kwargs)
+
+
+if hasattr(jax.lax, "pcast"):
+    pcast = jax.lax.pcast
+else:  # pragma: no cover - version-dependent
+
+    def pcast(x, axes=None, to=None):
+        return x
+
+
+__all__ = ["shard_map", "pcast"]

@@ -931,13 +931,16 @@ void* man_wp_create(const char* vocab_blob, long long n_bytes,
   const char* endp = vocab_blob + n_bytes;
   int32_t idx = 0;
   while (p < endp) {
-    const char* nl = (const char*)memchr(p, '\n', (size_t)(endp - p));
-    size_t len = nl ? (size_t)(nl - p) : (size_t)(endp - p);
-    if (len > 0 && p[len - 1] == '\r') --len;  // \r\n files, like text mode
+    // Universal-newline line split, matching the Python tokenizer's
+    // text-mode read: '\n', '\r\n', AND bare '\r' all terminate a line
+    // (classic-Mac vocab files used to shift every id by fusing lines).
+    const char* q = p;
+    while (q < endp && *q != '\n' && *q != '\r') ++q;
     // Assignment (not emplace): duplicate lines keep the LAST index, the
     // Python dict-comprehension behavior.
-    v->map[std::string(p, len)] = idx++;
-    p = nl ? nl + 1 : endp;
+    v->map[std::string(p, (size_t)(q - p))] = idx++;
+    if (q < endp) q += (*q == '\r' && q + 1 < endp && q[1] == '\n') ? 2 : 1;
+    p = q;
   }
   auto find = [&](const char* t) -> int32_t {
     auto it = v->map.find(t);

@@ -75,9 +75,10 @@ def test_multi_axis_mesh_histogram():
 
     import jax.numpy as jnp
     from music_analyst_tpu.ops.histogram import token_histogram
+    from music_analyst_tpu.utils.jax_compat import shard_map
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda x: jax.lax.psum(token_histogram(x, 10), "dp"),
             mesh=mesh,
             in_specs=P("dp"),

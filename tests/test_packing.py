@@ -133,6 +133,19 @@ def test_packed_confidences_match_flat():
     )
 
 
+def test_packed_index_wire_dtype():
+    """Segment starts/row lengths ride the wire at int16 only when every
+    position in [0, max_len] fits (max_len is the empty-slot sentinel);
+    the wide dtype is behavior-identical, so the narrowing is pure wire
+    format."""
+    cfg = _f32_tiny()
+    clf = DistilBertClassifier(config=cfg, max_len=64, seed=3, packed=True)
+    assert clf._index_dtype is np.int16  # 64 < 2**15
+    narrow = clf.classify_batch(TEXTS)
+    clf._index_dtype = np.int32  # what a >= 2**15 max_len selects
+    assert clf.classify_batch(TEXTS) == narrow
+
+
 def test_packed_segment_isolation():
     """A lyric's result must not depend on its row-mates: classify it
     alone vs packed among neighbors and compare confidences."""

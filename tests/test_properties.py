@@ -9,7 +9,10 @@ still honor the reference semantics (SURVEY.md §5 contracts 1-2).
 import csv
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from music_analyst_tpu.data.csv_io import sort_count_entries, write_count_csv
 from music_analyst_tpu.data.tokenizer import tokenize_ascii

@@ -1,0 +1,145 @@
+"""Telemetry must never violate the driver-facing contracts.
+
+Two hard lines in the sand: ``bench.py`` keeps printing exactly ONE JSON
+line on stdout with the telemetry sub-object riding inside it, and
+``--no-telemetry`` CLI runs leave ZERO extra files behind.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+from music_analyst_tpu.cli.main import main  # noqa: E402
+from music_analyst_tpu.telemetry import configure, get_telemetry  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _restore_telemetry():
+    """main() calls configure(); undo whatever a test left behind."""
+    yield
+    configure(enabled=True, directory=None)
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, s):
+        self.now += s
+
+
+def test_bench_payload_with_telemetry_is_one_line(capsys):
+    """A child payload carrying the ``telemetry`` sub-object passes the
+    parent verbatim — still exactly one stdout line."""
+    clock = FakeClock()
+    payload = {
+        "metric": bench.METRIC,
+        "value": 1234.5,
+        "unit": "songs/sec",
+        "vs_baseline": 0.6,
+        "telemetry": {
+            "events": 7,
+            "top_spans": [
+                {"name": "measure", "count": 1, "total_s": 2.0, "max_s": 2.0}
+            ],
+            "compile": {"count": 3, "seconds": 11.0},
+        },
+    }
+
+    def run(cmd, capture_output, text, timeout):
+        clock.advance(3.0 if "--probe" in cmd else 30.0)
+        out = "1\n" if "--probe" in cmd else json.dumps(payload) + "\n"
+        return subprocess.CompletedProcess(cmd, 0, stdout=out, stderr="")
+
+    rc = bench._run_parent(
+        4, bench._DEFAULT_DEADLINE_S,
+        run=run, sleep=clock.advance, clock=clock,
+    )
+    assert rc == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1, f"expected exactly one stdout line, got {lines!r}"
+    got = json.loads(lines[0])
+    assert got == payload
+    assert got["telemetry"]["compile"]["count"] == 3
+
+
+def test_bench_measure_summary_shape():
+    """The summary measure() embeds has the fixed three-key shape the
+    capture tooling reads (without running the heavy measurement)."""
+    tel = configure(enabled=True, directory=None)
+    with tel.span("measure"):
+        pass
+    summary = tel.summary(top=3)
+    assert set(summary) == {"events", "top_spans", "compile"}
+    assert summary["events"] >= 1
+    assert summary["top_spans"][0]["name"] == "measure"
+    assert {"count", "seconds"} <= set(summary["compile"])
+
+
+def test_cli_no_telemetry_writes_zero_extra_files(fixture_csv, tmp_path):
+    out = tmp_path / "out"
+    rc = main([
+        "wordcount-per-song", str(fixture_csv),
+        "--output-dir", str(out), "--no-telemetry",
+    ])
+    assert rc == 0
+    assert sorted(p.name for p in out.iterdir()) == [
+        "word_counts_by_song.csv", "word_counts_global.csv",
+    ]
+    assert not get_telemetry().enabled
+
+
+def test_cli_telemetry_dir_emits_parseable_artifacts(fixture_csv, tmp_path):
+    out, tdir = tmp_path / "out", tmp_path / "telemetry"
+    rc = main([
+        "sentiment", str(fixture_csv), "--mock", "--limit", "3",
+        "--output-dir", str(out), "--telemetry-dir", str(tdir),
+    ])
+    assert rc == 0
+    events = [
+        json.loads(line)
+        for line in (tdir / "telemetry.jsonl").read_text().splitlines()
+    ]
+    assert events and all("t_mono" in ev for ev in events)
+    manifest = json.loads((tdir / "run_manifest.json").read_text())
+    assert manifest["engine"] == "sentiment"
+    assert manifest["device"]["platform"] == "cpu"
+    assert manifest["device"]["count"] == 8
+    assert "compile" in manifest
+    # The run's own output dir got no telemetry files — they went to the
+    # explicit --telemetry-dir.
+    assert not (out / "telemetry.jsonl").exists()
+    assert not (out / "run_manifest.json").exists()
+
+
+def test_cli_default_telemetry_lands_in_output_dir(fixture_csv, tmp_path):
+    out = tmp_path / "out"
+    rc = main([
+        "wordcount-per-song", str(fixture_csv), "--output-dir", str(out),
+    ])
+    assert rc == 0
+    assert (out / "telemetry.jsonl").exists()
+    manifest = json.loads((out / "run_manifest.json").read_text())
+    assert manifest["engine"] == "persong"
+    assert manifest["counters"]["rows_processed"] > 0
+
+
+def test_split_stays_memory_only_without_flag(fixture_csv, tmp_path):
+    """The split listing is a compared artifact: no telemetry files may
+    appear in its output dir unless --telemetry-dir points elsewhere."""
+    cols = tmp_path / "cols"
+    rc = main(["split", str(fixture_csv), "--output-dir", str(cols)])
+    assert rc == 0
+    assert not any(p.name.startswith(("telemetry", "run_manifest"))
+                   for p in cols.iterdir())

@@ -158,6 +158,35 @@ def test_native_wordpiece_matches_python(pair, tmp_path_factory):
     np.testing.assert_array_equal(got_lens, want_lens)
 
 
+def test_native_wordpiece_universal_newline_vocab(pair, tmp_path_factory):
+    """Bare-``\\r`` and ``\\r\\n`` vocab line terminators parse like the
+    Python tokenizer's text-mode (universal-newline) read — a classic-Mac
+    vocab used to fuse lines natively, shifting every later id by one."""
+    from music_analyst_tpu.data import native
+    from music_analyst_tpu.models.tokenization import (
+        NativeWordPieceTokenizer,
+    )
+
+    if not native.available():
+        pytest.skip(f"native lib unavailable: {native.unavailable_reason()}")
+    path = tmp_path_factory.mktemp("crvocab") / "vocab.txt"
+    terminators = ["\r", "\r\n", "\n"]
+    blob = "".join(
+        tok + terminators[i % len(terminators)]
+        for i, tok in enumerate(VOCAB)
+    )
+    path.write_bytes(blob.encode("utf-8"))
+    py = WordPieceTokenizer(str(path))
+    nat = NativeWordPieceTokenizer(str(path))
+    assert nat._handle is not None
+
+    texts = ["love the rain", "don't stop loving", "rains rain rained"]
+    want_ids, want_lens = py.encode_batch(texts, 16)
+    got_ids, got_lens = nat.encode_batch(texts, 16)
+    np.testing.assert_array_equal(got_ids, want_ids)
+    np.testing.assert_array_equal(got_lens, want_lens)
+
+
 def test_native_wordpiece_refuses_vocab_without_specials(tmp_path_factory):
     from music_analyst_tpu.data import native
 

@@ -1,0 +1,29 @@
+# Top-level convenience targets.  The native library has its own Makefile
+# (make -C native); tests force the CPU platform via tests/conftest.py.
+
+PY ?= python
+
+.PHONY: smoke test native
+
+# Fast observability gate: profiling + telemetry unit tests, then one
+# smoke-shaped bench.py run through the full parent/child/--baseline
+# machinery, asserting the ONE-JSON-line stdout contract the round driver
+# depends on.  Runs in a couple of minutes on the sandboxed CPU.
+smoke:
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+		$(PY) -m pytest tests/test_profiling.py tests/test_telemetry.py \
+		tests/test_telemetry_contract.py -q
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= MUSICAAL_BENCH_SMOKE=1 \
+		$(PY) bench.py --baseline --attempts 1 --deadline 240 \
+		| $(PY) -c "import json,sys; \
+lines=[l for l in sys.stdin.read().splitlines() if l.strip()]; \
+assert len(lines)==1, f'expected ONE JSON line, got {len(lines)}'; \
+payload=json.loads(lines[0]); \
+assert 'vs_baseline_detail' in payload, 'missing --baseline detail'; \
+print('smoke ok:', payload['metric'], payload['value'])"
+
+test:
+	$(PY) -m pytest tests/ -q
+
+native:
+	$(MAKE) -C native

@@ -123,8 +123,17 @@ def measure() -> dict:
     from music_analyst_tpu.data.csv_io import iter_songs
     from music_analyst_tpu.models.distilbert import DistilBertClassifier
 
-    dataset = "/tmp/musicaal_bench_songs.csv"
-    n_songs = 16_384
+    # MUSICAAL_BENCH_SMOKE=1: CI-sized run (tiny model, 512 songs) so
+    # `make smoke` can exercise the full contract — child process, salvage,
+    # --baseline comparison — in seconds.  The payload carries
+    # ``"smoke": true`` and capture_all.sh refuses to publish it.
+    smoke = os.environ.get("MUSICAAL_BENCH_SMOKE") == "1"
+    if smoke:
+        dataset = "/tmp/musicaal_bench_songs_smoke.csv"
+        n_songs = 512
+    else:
+        dataset = "/tmp/musicaal_bench_songs.csv"
+        n_songs = 16_384
     if not os.path.exists(dataset):
         generate_dataset(dataset, num_songs=n_songs, seed=11)
     texts = [text for _, _, text in iter_songs(dataset)]
@@ -136,7 +145,9 @@ def measure() -> dict:
     # MUSICAAL_BENCH_MODEL switches the headline configuration (e.g.
     # "distilbert-int8" for the dynamic-quant MXU path); the sentiment_int8
     # suite is the A/B that justifies any non-default choice.
-    model = os.environ.get("MUSICAAL_BENCH_MODEL", "distilbert")
+    model = os.environ.get(
+        "MUSICAAL_BENCH_MODEL", "distilbert-tiny" if smoke else "distilbert"
+    )
     allowed = {
         f"distilbert{size}{quant}{pack}"
         for size in ("", "-tiny")
@@ -159,7 +170,8 @@ def measure() -> dict:
         length_buckets=None if packed else "auto",
     )
     precision = "int8" if clf.config.quant == "int8" else "bf16"
-    batch = 8192  # measured best on v5e: ~10% over 4096 (amortizes dispatch)
+    # 8192 measured best on v5e: ~10% over 4096 (amortizes dispatch).
+    batch = 256 if smoke else 8192
 
     # Warmup: compile + first dispatch.
     with tel.span("warmup", rows=batch):
@@ -182,7 +194,7 @@ def measure() -> dict:
 
     songs_per_sec = len(texts) / elapsed
     tel.count("rows_classified", len(texts))
-    return {
+    payload = {
         "telemetry": tel.summary(top=3),
         "metric": METRIC,
         "value": round(songs_per_sec, 1),
@@ -194,6 +206,9 @@ def measure() -> dict:
         "length_buckets": list(clf.length_buckets or ()),
         "packed": packed,
     }
+    if smoke:
+        payload["smoke"] = True
+    return payload
 
 
 def _run_child() -> int:
@@ -236,6 +251,63 @@ def _probe_device(run, budget: float) -> tuple[str, str]:
     return "ok", ""
 
 
+def _find_baseline(results_dir: str | None = None) -> tuple[str, float] | None:
+    """Newest committed ``BENCH_r*.json`` whose parsed value is usable.
+
+    "Usable" = the driver capture parsed to a positive headline value
+    (failed rounds carry 0.0/None and cannot anchor a ratio).  Round files
+    sort lexically, so the last usable one is the newest.
+    """
+    import glob
+
+    if results_dir is None:
+        # Round captures live next to bench.py (BENCH_r01.json, ...).
+        results_dir = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for path in sorted(glob.glob(os.path.join(results_dir, "BENCH_r*.json"))):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                capture = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = capture.get("parsed") or {}
+        value = parsed.get("value")
+        if isinstance(value, (int, float)) and value > 0:
+            best = (os.path.basename(path), float(value))
+    return best
+
+
+def _baseline_augment(threshold: float = 0.1,
+                      results_dir: str | None = None):
+    """``--baseline``: embed a vs-committed-capture comparison in the line.
+
+    Returns an augment hook for :func:`_run_parent`; the default (no
+    ``--baseline``) stays the identity — ``tests/test_bench_budget.py``
+    pins exact payload passthrough.
+    """
+    base = _find_baseline(results_dir)
+
+    def augment(payload: dict) -> dict:
+        if base is None:
+            payload["vs_baseline_detail"] = {
+                "baseline_file": None,
+                "error": "no usable BENCH_r*.json capture",
+            }
+            return payload
+        name, value = base
+        current = payload.get("value") or 0.0
+        payload["vs_baseline_detail"] = {
+            "baseline_file": name,
+            "baseline_value": value,
+            "ratio": round(current / value, 3),
+            "regression": bool((value - current) / value > threshold),
+            "threshold": threshold,
+        }
+        return payload
+
+    return augment
+
+
 def _last_json_line(text: str) -> dict | None:
     for line in reversed(text.strip().splitlines()):
         line = line.strip()
@@ -247,17 +319,21 @@ def _last_json_line(text: str) -> dict | None:
     return None
 
 
-def _salvage(stdout, *, require_metric: bool) -> bool:
+def _salvage(stdout, *, require_metric: bool, augment=None) -> bool:
     """Print a child's result line if its stdout carries one.
 
     ``require_metric`` gates on the headline metric name for children that
     did not exit cleanly, so a stray JSON line can't masquerade as success.
+    ``augment`` (the ``--baseline`` hook) may enrich the payload; ``None``
+    is strict passthrough.
     """
     if isinstance(stdout, bytes):
         stdout = stdout.decode(errors="replace")
     result = _last_json_line(stdout or "")
     if result is None or (require_metric and result.get("metric") != METRIC):
         return False
+    if augment is not None:
+        result = augment(result)
     print(json.dumps(result))
     return True
 
@@ -269,6 +345,7 @@ def _run_parent(
     run=subprocess.run,
     sleep=time.sleep,
     clock=time.monotonic,
+    augment=None,
 ) -> int:
     """Attempt the measurement under one hard wall-clock deadline.
 
@@ -334,14 +411,15 @@ def _run_parent(
             # A child can print the result line and then hang in interpreter
             # teardown (axon tunnel threads) — salvage its stdout before
             # writing the attempt off.
-            if _salvage(exc.stdout, require_metric=True):
+            if _salvage(exc.stdout, require_metric=True, augment=augment):
                 return 0
             last_error = f"attempt timed out after {budget:.0f}s (tunnel hang?)"
         if proc is not None:
             # A completed measurement counts even when the interpreter died
             # non-zero afterwards (axon teardown) — same salvage rule as the
             # timeout path.
-            if _salvage(proc.stdout, require_metric=proc.returncode != 0):
+            if _salvage(proc.stdout, require_metric=proc.returncode != 0,
+                        augment=augment):
                 return 0
             tail = (proc.stderr or proc.stdout or "").strip().splitlines()
             last_error = (
@@ -357,18 +435,17 @@ def _run_parent(
             sleep(min(gap, affordable))
     # Terminal failure: still exactly one parseable JSON line, emitted
     # BEFORE the deadline (the loop guard guarantees ≥ SAFETY_S remains).
-    print(
-        json.dumps(
-            {
-                "metric": METRIC,
-                "value": 0.0,
-                "unit": "songs/sec (benchmark failed; see error)",
-                "vs_baseline": 0.0,
-                "error": last_error[-800:],
-                "gave_up_after_s": round(clock() - start, 1),
-            }
-        )
-    )
+    payload = {
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": "songs/sec (benchmark failed; see error)",
+        "vs_baseline": 0.0,
+        "error": last_error[-800:],
+        "gave_up_after_s": round(clock() - start, 1),
+    }
+    if augment is not None:
+        payload = augment(payload)
+    print(json.dumps(payload))
     return 0
 
 
@@ -392,6 +469,17 @@ def main(argv: list[str] | None = None) -> int:
              "headline metric (see --list-suites)",
     )
     parser.add_argument("--list-suites", action="store_true")
+    parser.add_argument(
+        "--baseline", action="store_true",
+        help="Embed vs_baseline_detail (comparison against the newest "
+             "usable benchmarks/results/BENCH_r*.json capture) in the "
+             "output line",
+    )
+    parser.add_argument(
+        "--baseline-threshold", type=float, default=0.1,
+        help="Relative throughput drop vs the baseline capture that "
+             "flags regression=true (default 0.10)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_suites or args.suite:
@@ -405,7 +493,10 @@ def main(argv: list[str] | None = None) -> int:
         return _probe_child()
     if args.child:
         return _run_child()
-    return _run_parent(args.attempts, args.deadline)
+    augment = (
+        _baseline_augment(args.baseline_threshold) if args.baseline else None
+    )
+    return _run_parent(args.attempts, args.deadline, augment=augment)
 
 
 if __name__ == "__main__":

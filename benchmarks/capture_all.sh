@@ -54,7 +54,32 @@ for suite in $suites; do
         fi
     else
         rm -f "$tmp"
-        echo "    FAILED (see /tmp/capture_${suite}.err)" >&2
+        # Structured error stub (same schema as bench.py's terminal error
+        # line) so a dead-tunnel capture session leaves machine-readable
+        # evidence in results/ instead of only a stderr note.  Written to
+        # <suite>.error.json — the last good <suite>.json stays in place.
+        python - "$suite" "$out_dir" /tmp/capture_${suite}.err <<'PYEOF'
+import json, os, sys
+suite, out_dir, err_path = sys.argv[1:4]
+try:
+    with open(err_path, encoding="utf-8", errors="replace") as fh:
+        tail = " | ".join(fh.read().strip().splitlines()[-3:])
+except OSError:
+    tail = "suite timed out or crashed before writing stderr"
+stub = {
+    "metric": f"suite:{suite}",
+    "value": 0.0,
+    "unit": "capture failed; see error",
+    "vs_baseline": 0.0,
+    "error": (tail or "capture failed with empty stderr")[-800:],
+    "gave_up_after_s": 0.0,
+}
+path = os.path.join(out_dir, f"{suite}.error.json")
+with open(path, "w", encoding="utf-8") as fh:
+    json.dump(stub, fh)
+    fh.write("\n")
+print(f"    FAILED -> {path} (see {err_path})", file=sys.stderr)
+PYEOF
     fi
 done
 

@@ -7,6 +7,11 @@ with the same flags plus TPU-era additions (``--device``, ``--batch-size``):
 * ``sentiment`` ≙ ``scripts/sentiment_classifier.py``
 * ``wordcount-per-song`` ≙ ``scripts/word_count_per_song.py``
 * ``split``     ≙ ``scripts/split_csv_columns.py``
+
+TPU-era subcommands with no reference analogue: ``sweep`` (scaling
+sweeps), ``validate`` (weight certification), and ``profile-diff`` (the
+perf-regression gate over run manifests / bench lines).  Every run-scoped
+subcommand takes ``--profile-dir`` to capture device + span traces.
 """
 
 from __future__ import annotations
@@ -51,6 +56,10 @@ def _add_telemetry_flags(p: argparse.ArgumentParser) -> None:
                         "(default: the run's output dir)")
     p.add_argument("--no-telemetry", action="store_true",
                    help="Disable run telemetry entirely (no extra files)")
+    p.add_argument("--profile-dir", default=None,
+                   help="Capture a device profiler trace + span-level "
+                        "Chrome trace (trace_spans.json) into this dir "
+                        "(profiling/trace.py)")
 
 
 def _add_analyze(sub: argparse._SubParsersAction) -> None:
@@ -168,6 +177,23 @@ def _add_validate(sub: argparse._SubParsersAction) -> None:
     _add_telemetry_flags(p)
 
 
+def _add_profile_diff(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "profile-diff",
+        help="perf-regression gate: compare two run manifests / bench "
+             "lines; exit 1 on regression (profiling/diff.py)",
+    )
+    p.add_argument("a", help="Baseline: run_manifest.json, a bench JSON "
+                             "line file, or literal JSON")
+    p.add_argument("b", help="Candidate, same formats")
+    p.add_argument("--threshold", type=float, default=0.1,
+                   help="Relative throughput drop that fails the gate "
+                        "(default 0.10)")
+    p.add_argument("--wall-threshold", type=float, default=0.25,
+                   help="Relative wall-clock growth that fails the gate "
+                        "for manifests (default 0.25)")
+
+
 def _add_sweep(sub: argparse._SubParsersAction) -> None:
     p = sub.add_parser(
         "sweep",
@@ -193,13 +219,33 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_split(sub)
     _add_sweep(sub)
     _add_validate(sub)
+    _add_profile_diff(sub)
     args = parser.parse_args(argv)
+
+    if args.command == "profile-diff":
+        # Pure host-side comparison: no telemetry scope, no jax import.
+        from music_analyst_tpu.profiling.diff import run_profile_diff
+
+        return run_profile_diff(
+            args.a, args.b,
+            threshold=args.threshold,
+            wall_threshold=args.wall_threshold,
+        )
 
     from music_analyst_tpu.telemetry import configure
 
     configure(
         enabled=not args.no_telemetry, directory=args.telemetry_dir
     )
+
+    from music_analyst_tpu.profiling.trace import profile_run
+
+    with profile_run(getattr(args, "profile_dir", None)):
+        return _dispatch(parser, args)
+
+
+def _dispatch(parser: argparse.ArgumentParser,
+              args: argparse.Namespace) -> int:
 
     if args.command == "validate":
         from music_analyst_tpu.engines.validate import run_validation
@@ -238,8 +284,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     if args.command == "analyze":
-        from music_analyst_tpu.metrics.tracing import maybe_trace
         from music_analyst_tpu.parallel.mesh import data_parallel_mesh
+        from music_analyst_tpu.profiling.trace import maybe_trace
 
         mesh = data_parallel_mesh(args.devices) if args.devices else None
         if args.with_sentiment:
@@ -278,7 +324,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.command == "sentiment":
         from music_analyst_tpu.engines.sentiment import run_sentiment
-        from music_analyst_tpu.metrics.tracing import maybe_trace
+        from music_analyst_tpu.profiling.trace import maybe_trace
 
         # Fail as a usage error, not a mid-run traceback: buckets only
         # apply to the encoder classifier family (engines/sentiment.py

@@ -53,12 +53,20 @@ def _sweep_points(
     tel, summary, dataset_path, device_counts, n_available, output_dir,
     ingest_backend, quiet,
 ) -> None:
+    def _profile_counters() -> dict:
+        with tel._lock:
+            return {
+                k: v for k, v in tel.counters.items()
+                if k.startswith(("profiling.", "collectives."))
+            }
+
     base_wall = None
     for n in device_counts:
         if n > n_available:
             print(f"skipping np={n}: only {n_available} devices")
             continue
         mesh = data_parallel_mesh(n)
+        before = _profile_counters()
         start = time.perf_counter()
         with tel.span("sweep_point", devices=n):
             run_analysis(
@@ -71,6 +79,17 @@ def _sweep_points(
             )
         wall = time.perf_counter() - start
         tel.count("sweep_points")
+        # Per-point profiling delta: each point's own compiles/collective
+        # bytes, not the cumulative totals — the per-N scaling signal
+        # (bytes should grow ~linearly in N for the psum merges).
+        after = _profile_counters()
+        delta = {
+            k: after[k] - before.get(k, 0)
+            for k in after
+            if after[k] != before.get(k, 0)
+        }
+        tel.event("sweep_point_profile", devices=n,
+                  wall_seconds=round(wall, 6), **delta)
         # Archive this point's metrics (the reference overwrites them).
         src = os.path.join(output_dir, "performance_metrics.json")
         dst = os.path.join(output_dir, f"performance_metrics_np{n}.json")

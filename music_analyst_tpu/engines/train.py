@@ -236,8 +236,12 @@ def make_train_step(model, optimizer, mesh: Optional[Mesh] = None):
             loss,
         )
 
+    from music_analyst_tpu.profiling.compile import profiled_jit
+
     if mesh is None:
-        return _with_step_telemetry(jax.jit(step_fn))
+        return _with_step_telemetry(
+            profiled_jit(step_fn, name="train_step")
+        )
 
     data_axes = [a for a in ("dp", "sp") if a in mesh.axis_names]
     dp = data_axes[0] if data_axes else None
@@ -291,8 +295,9 @@ def make_train_step(model, optimizer, mesh: Optional[Mesh] = None):
             )
             jitted = jitted_by_layout.get(key)
             if jitted is None:
-                jitted = jax.jit(
-                    sharded_step, out_shardings=(shardings, None)
+                jitted = profiled_jit(
+                    sharded_step, name="train_step_sharded",
+                    out_shardings=(shardings, None),
                 )
                 jitted_by_layout[key] = jitted
         new_state, loss = jitted(state, token_ids, lengths, segment_ids)

@@ -37,6 +37,7 @@ from music_analyst_tpu.ops.histogram import (
     sharded_histogram_hostlocal_timed,
 )
 from music_analyst_tpu.parallel.mesh import data_parallel_mesh
+from music_analyst_tpu.profiling.trace import annotate
 
 
 @dataclasses.dataclass
@@ -141,12 +142,17 @@ def _run_analysis_instrumented(
         # when the ids are already device-resident (selectable via
         # ``analyze --count-mode``).
         if count_mode == "host-shard":
-            word_counts, word_times = sharded_histogram_hostlocal_timed(
-                corpus.word_ids, max(1, len(corpus.word_vocab)), mesh
-            )
-            artist_counts, artist_times = sharded_histogram_hostlocal_timed(
-                corpus.artist_ids, max(1, len(corpus.artist_vocab)), mesh
-            )
+            with annotate("wordcount.word_histogram"):
+                word_counts, word_times = sharded_histogram_hostlocal_timed(
+                    corpus.word_ids, max(1, len(corpus.word_vocab)), mesh
+                )
+            with annotate("wordcount.artist_histogram"):
+                artist_counts, artist_times = (
+                    sharded_histogram_hostlocal_timed(
+                        corpus.artist_ids, max(1, len(corpus.artist_vocab)),
+                        mesh,
+                    )
+                )
             # Shard i's measured compute: its own count phases plus the
             # lock-stepped collective merges every chip sits in together.
             per_shard = [
@@ -165,16 +171,19 @@ def _run_analysis_instrumented(
             ].flatten()
             per_chip_compute = [per_shard[c] for c in dp_coord]
         else:
-            word_counts = np.asarray(
-                sharded_histogram(
-                    corpus.word_ids, max(1, len(corpus.word_vocab)), mesh
+            with annotate("wordcount.word_histogram"):
+                word_counts = np.asarray(
+                    sharded_histogram(
+                        corpus.word_ids, max(1, len(corpus.word_vocab)), mesh
+                    )
                 )
-            )
-            artist_counts = np.asarray(
-                sharded_histogram(
-                    corpus.artist_ids, max(1, len(corpus.artist_vocab)), mesh
+            with annotate("wordcount.artist_histogram"):
+                artist_counts = np.asarray(
+                    sharded_histogram(
+                        corpus.artist_ids, max(1, len(corpus.artist_vocab)),
+                        mesh,
+                    )
                 )
-            )
             # One fused SPMD program: chips are lock-stepped, so each
             # chip's compute IS the program wall-clock (documented
             # TimeStats.uniform semantics).

@@ -1,31 +1,18 @@
-"""Profiler tracing hooks.
+"""Deprecated: moved to ``music_analyst_tpu.profiling.trace``.
 
-The reference's only observability is wall-clock timestamps (SURVEY.md §5
-"Tracing/profiling: wall-clock only").  Here any engine run can capture a
-full XLA/TPU profiler trace (HLO timelines, per-op device time) viewable in
-TensorBoard/Perfetto, via one context manager.
+This shim keeps ``from music_analyst_tpu.metrics.tracing import
+maybe_trace, annotate`` working; new code should import from
+``profiling.trace`` (which adds :func:`profile_run`, the span-level
+Chrome trace, and :func:`force_readback`).
 """
 
 from __future__ import annotations
 
-import contextlib
-from typing import Iterator, Optional
+from music_analyst_tpu.profiling.trace import (  # noqa: F401
+    annotate,
+    force_readback,
+    maybe_trace,
+    profile_run,
+)
 
-import jax
-
-
-@contextlib.contextmanager
-def maybe_trace(trace_dir: Optional[str]) -> Iterator[None]:
-    """Capture a ``jax.profiler`` trace into ``trace_dir`` when set."""
-    if not trace_dir:
-        yield
-        return
-    with jax.profiler.trace(trace_dir):
-        yield
-
-
-@contextlib.contextmanager
-def annotate(name: str) -> Iterator[None]:
-    """Named region that shows up on the profiler timeline."""
-    with jax.profiler.TraceAnnotation(name):
-        yield
+__all__ = ["annotate", "force_readback", "maybe_trace", "profile_run"]

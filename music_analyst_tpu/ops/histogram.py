@@ -31,14 +31,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from music_analyst_tpu.profiling.collectives import record_collective
+from music_analyst_tpu.profiling.compile import profiled_jit
 from music_analyst_tpu.utils.jax_compat import shard_map
 from music_analyst_tpu.utils.shapes import round_pow2
 
 PAD_ID = -1
 
 
-@partial(jax.jit, static_argnames=("vocab_size",))
-def token_histogram(ids: jax.Array, vocab_size: int) -> jax.Array:
+def _token_histogram(ids: jax.Array, vocab_size: int) -> jax.Array:
     """Count id occurrences; ``PAD_ID`` (any negative id) is ignored.
 
     One fused masked scatter-add; int32 counts (the per-word corpus bound is
@@ -49,6 +50,12 @@ def token_histogram(ids: jax.Array, vocab_size: int) -> jax.Array:
     return jnp.zeros((vocab_size,), jnp.int32).at[clipped].add(
         valid.astype(jnp.int32), mode="drop"
     )
+
+
+token_histogram = profiled_jit(
+    _token_histogram, name="token_histogram",
+    static_argnames=("vocab_size",),
+)
 
 
 def shard_pad(values: np.ndarray, shards: int, pad_value: int) -> np.ndarray:
@@ -88,8 +95,9 @@ def _psum_ids_histogram(mesh: Mesh, axis: str, padded_vocab: int):
     def local(x):
         return jax.lax.psum(token_histogram(x, padded_vocab), axis)
 
-    return jax.jit(
-        shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P())
+    return profiled_jit(
+        shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P()),
+        name="psum_ids_histogram",
     )
 
 
@@ -98,8 +106,9 @@ def _psum_rows(mesh: Mesh, axis: str):
     def local(h):
         return jax.lax.psum(h[0], axis)
 
-    return jax.jit(
-        shard_map(local, mesh=mesh, in_specs=P(axis, None), out_specs=P())
+    return profiled_jit(
+        shard_map(local, mesh=mesh, in_specs=P(axis, None), out_specs=P()),
+        name="psum_rows",
     )
 
 
@@ -108,8 +117,9 @@ def _psum_scalar(mesh: Mesh, axis: str):
     def local(x):
         return jax.lax.psum(jnp.sum(x), axis)
 
-    return jax.jit(
-        shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P())
+    return profiled_jit(
+        shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P()),
+        name="psum_scalar",
     )
 
 
@@ -136,6 +146,12 @@ def sharded_histogram(
     padded[: ids.shape[0]] = ids
     padded = shard_pad(padded, mesh.shape[axis], PAD_ID)
     padded_vocab = _bucket(vocab_size, 1 << 10)
+    # Each device all-reduces its padded_vocab-wide int32 histogram.
+    record_collective(
+        "histogram.device_ids", "psum",
+        payload_bytes=padded_vocab * 4, n_devices=mesh.shape[axis],
+        axis=axis,
+    )
     return _psum_ids_histogram(mesh, axis, padded_vocab)(padded)[:vocab_size]
 
 
@@ -189,7 +205,12 @@ def sharded_histogram_hostlocal_timed(
         if valid.size:
             local[i] = np.bincount(valid, minlength=padded_vocab)
         count_seconds.append(time.perf_counter() - t0)
+    record_collective(
+        "histogram.hostlocal_merge", "psum",
+        payload_bytes=padded_vocab * 4, n_devices=shards, axis=axis,
+    )
     t0 = time.perf_counter()
+    # np.asarray IS the sync point (axon tunnel gotcha — see engine note).
     merged = np.asarray(_psum_rows(mesh, axis)(local))[:vocab_size]
     merge_seconds = time.perf_counter() - t0
     return merged, HistogramTimings(tuple(count_seconds), merge_seconds)
@@ -214,4 +235,8 @@ def sharded_total(values: np.ndarray, mesh: Mesh, axis: str = "dp") -> int:
     contributes zeros.
     """
     padded = shard_pad(np.asarray(values, dtype=np.int64), mesh.shape[axis], 0)
+    record_collective(
+        "histogram.scalar_total", "psum",
+        payload_bytes=8, n_devices=mesh.shape[axis], axis=axis,
+    )
     return int(_psum_scalar(mesh, axis)(padded))

@@ -77,7 +77,11 @@ def allgather_bytes(payload: bytes) -> list:
     width = max(1, int(lengths.max()))
     row = np.zeros((width,), dtype=np.uint8)
     row[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
-    rows = multihost_utils.process_allgather(row)
+    # The collective may return a widened dtype (psum-backed transport
+    # upcasts uint8); restore it before reading raw bytes back out.
+    rows = np.asarray(
+        multihost_utils.process_allgather(row), dtype=np.uint8
+    )
     return [
         rows[i, : int(lengths[i])].tobytes()
         for i in range(jax.process_count())
@@ -102,5 +106,7 @@ def broadcast_bytes(payload: Optional[bytes]) -> bytes:
     row = np.zeros((max(1, length),), dtype=np.uint8)
     if is_coordinator():
         row[:length] = np.frombuffer(data, dtype=np.uint8)
-    row = broadcast_from_coordinator(row)
+    # Same dtype restore as allgather_bytes: broadcast_one_to_all rides a
+    # psum that upcasts uint8, and tobytes() on int32 reads 4x the bytes.
+    row = np.asarray(broadcast_from_coordinator(row), dtype=np.uint8)
     return row[:length].tobytes()

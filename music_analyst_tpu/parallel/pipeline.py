@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from music_analyst_tpu.profiling.collectives import record_collective
 from music_analyst_tpu.utils.jax_compat import pcast, shard_map
 
 
@@ -124,6 +125,22 @@ def pipeline_apply(
         )
         return outputs
 
+    # Analytic wire accounting: one activation ppermute per tick (ticks =
+    # n_micro + n_stages - 1), then the final psum that broadcasts the
+    # last stage's [n_micro, mb, ...] outputs to every device.
+    n_micro = microbatches.shape[0]
+    act_bytes = int(
+        np.prod(microbatches.shape[1:]) * microbatches.dtype.itemsize
+    )
+    record_collective(
+        "pipeline.activation_shift", "ppermute",
+        payload_bytes=act_bytes, n_devices=n_stages, axis=axis,
+        count=n_micro + n_stages - 1,
+    )
+    record_collective(
+        "pipeline.output_broadcast", "psum",
+        payload_bytes=n_micro * act_bytes, n_devices=n_stages, axis=axis,
+    )
     return shard_map(
         body,
         mesh=mesh,

@@ -1,0 +1,243 @@
+"""Compile-boundary introspection: :func:`profiled_jit`.
+
+Wraps the jit lower/compile boundary the engines use so every compiled
+program records what it costs before it ever runs:
+
+* ``cost_analysis()`` — FLOPs and bytes-accessed per execution,
+* ``memory_analysis()`` — temp/argument/output allocation bytes (TPU
+  backends implement it; CPU returns nothing and the field stays null),
+* an HLO fingerprint (sha256 of the lowered StableHLO text) so two runs
+  can prove they executed the same program, and
+* a **recompile detector**: calls are keyed on their abstract avals
+  (shape/dtype of every array leaf + values of everything static); a new
+  key after the first compile bumps ``profiling.recompiles`` and emits a
+  ``recompile`` event naming the offending shape change — the telemetry
+  answer to "why is this run spending its wall-clock in XLA".
+
+The wrapper is a fallback-safe veneer over ``jax.jit``: the AOT
+``lower(...).compile()`` path feeds the records, and any AOT-ineligible
+call pattern (donated buffers, weak types the executable rejects, …)
+falls through to the plain jitted callable — numerics never depend on the
+profiler.  TPU note: executables are *invoked* exactly as jit would; no
+``block_until_ready`` anywhere (axon tunnel gotcha — readbacks stay the
+caller's ``np.asarray``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+# Process-lifetime registry of every ProfiledFunction, in creation order —
+# the manifest's ``profiling`` section reads it at run exit.
+_REGISTRY: List["ProfiledFunction"] = []
+_REGISTRY_LOCK = threading.Lock()
+
+
+def _leaf_sig(leaf: Any) -> str:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}{list(shape)}"
+    return repr(leaf)
+
+
+def _aval_key(args: tuple, kwargs: dict) -> str:
+    """Abstract signature of a call: array leaves contribute shape/dtype,
+    everything else (static ints, strings) its repr."""
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return ";".join(_leaf_sig(leaf) for leaf in leaves) + f"#{treedef}"
+
+
+def _scalar(analysis: Any, key: str) -> Optional[float]:
+    """Pull one metric out of ``cost_analysis()`` output, whose container
+    type changed across jax versions (dict vs [dict])."""
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not isinstance(analysis, dict):
+        return None
+    value = analysis.get(key)
+    try:
+        return float(value) if value is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
+class CompileRecord:
+    """One compiled program's cost/memory/fingerprint digest."""
+
+    __slots__ = (
+        "name", "aval_key", "flops", "bytes_accessed", "temp_bytes",
+        "argument_bytes", "output_bytes", "hlo_fingerprint",
+        "compile_seconds",
+    )
+
+    def __init__(self, name: str, aval_key: str) -> None:
+        self.name = name
+        self.aval_key = aval_key
+        self.flops: Optional[float] = None
+        self.bytes_accessed: Optional[float] = None
+        self.temp_bytes: Optional[int] = None
+        self.argument_bytes: Optional[int] = None
+        self.output_bytes: Optional[int] = None
+        self.hlo_fingerprint: Optional[str] = None
+        self.compile_seconds: float = 0.0
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "aval_key": self.aval_key,
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "temp_bytes": self.temp_bytes,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "hlo_fingerprint": self.hlo_fingerprint,
+            "compile_seconds": round(self.compile_seconds, 6),
+        }
+
+
+class ProfiledFunction:
+    """A jitted callable whose compiles are observed and keyed on avals."""
+
+    def __init__(self, fn: Callable, name: Optional[str] = None,
+                 **jit_kwargs: Any) -> None:
+        self._fn = fn
+        self.name = name or getattr(fn, "__name__", None) or "jit_fn"
+        self._jit = jax.jit(fn, **jit_kwargs)
+        self._lock = threading.Lock()
+        self._compiled: Dict[str, Any] = {}  # aval_key -> executable | None
+        self.records: Dict[str, CompileRecord] = {}
+        with _REGISTRY_LOCK:
+            _REGISTRY.append(self)
+
+    # -------------------------------------------------------- introspection
+
+    def _record(self, key: str, lowered: Any, compiled: Any,
+                seconds: float) -> CompileRecord:
+        rec = CompileRecord(self.name, key)
+        rec.compile_seconds = seconds
+        try:
+            rec.hlo_fingerprint = hashlib.sha256(
+                lowered.as_text().encode()
+            ).hexdigest()[:16]
+        except Exception:
+            pass
+        try:
+            cost = compiled.cost_analysis()
+            rec.flops = _scalar(cost, "flops")
+            rec.bytes_accessed = _scalar(cost, "bytes accessed")
+        except Exception:
+            pass
+        try:
+            mem = compiled.memory_analysis()
+            rec.temp_bytes = int(mem.temp_size_in_bytes)
+            rec.argument_bytes = int(mem.argument_size_in_bytes)
+            rec.output_bytes = int(mem.output_size_in_bytes)
+        except Exception:
+            pass  # CPU PJRT has no memory_analysis — fields stay null
+        return rec
+
+    def _compile_for(self, key: str, args: tuple, kwargs: dict) -> Any:
+        """AOT-compile for this aval key; record + count; None on failure."""
+        from music_analyst_tpu.telemetry import get_telemetry
+
+        tel = get_telemetry()
+        try:
+            t0 = time.perf_counter()
+            lowered = self._jit.lower(*args, **kwargs)
+            compiled = lowered.compile()
+            seconds = time.perf_counter() - t0
+        except Exception as exc:
+            # Not AOT-eligible (or the backend refused): the plain jit
+            # call still compiles and runs; we just lose the record.
+            tel.event("compile_introspection_failed", fn=self.name,
+                      error=str(exc)[:200])
+            return None
+        rec = self._record(key, lowered, compiled, seconds)
+        prior = list(self.records)
+        self.records[key] = rec
+        tel.count("profiling.compiles")
+        attrs = rec.as_dict()
+        attrs["fn"] = attrs.pop("name")  # "name" is the event name itself
+        tel.event("compile", **attrs)
+        if prior:
+            # Same function, new avals: that is THE recompile signature —
+            # log old→new so the offending shape change is one grep away.
+            tel.count("profiling.recompiles")
+            tel.event(
+                "recompile", fn=self.name, prev_aval=prior[-1],
+                new_aval=key, n_variants=len(prior) + 1,
+            )
+        return compiled
+
+    # --------------------------------------------------------------- call
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        # Called under an outer trace (jit-of-jit): no concrete inputs to
+        # AOT-compile against — defer to plain jit, which inlines.
+        if any(
+            isinstance(leaf, jax.core.Tracer)
+            for leaf in jax.tree_util.tree_leaves((args, kwargs))
+        ):
+            return self._jit(*args, **kwargs)
+        key = _aval_key(args, kwargs)
+        with self._lock:
+            known = key in self._compiled
+            executable = self._compiled.get(key)
+        if not known:
+            executable = self._compile_for(key, args, kwargs)
+            with self._lock:
+                self._compiled[key] = executable
+        if executable is not None:
+            try:
+                return executable(*args, **kwargs)
+            except Exception:
+                # Executable/argument mismatch (layout, weak type, …):
+                # permanently fall back for this key.
+                with self._lock:
+                    self._compiled[key] = None
+        return self._jit(*args, **kwargs)
+
+    # Parity helpers so a ProfiledFunction drops in where jax.jit was.
+    def lower(self, *args: Any, **kwargs: Any):
+        return self._jit.lower(*args, **kwargs)
+
+    def _cache_size(self) -> int:
+        """Compiled-variant count (jit cache + AOT executables): the
+        no-retrace tests assert this stays flat across repeat calls."""
+        with self._lock:
+            aot = len(self._compiled)
+        try:
+            return self._jit._cache_size() + aot
+        except Exception:
+            return aot
+
+
+def profiled_jit(fn: Callable, name: Optional[str] = None,
+                 **jit_kwargs: Any) -> ProfiledFunction:
+    """``jax.jit`` with compile introspection + recompile detection.
+
+    Drop-in at the engines' jit boundaries; see the module docstring for
+    what each compile records.  ``jit_kwargs`` pass through to ``jax.jit``
+    (``static_argnames``, ``out_shardings``, …).
+    """
+    return ProfiledFunction(fn, name=name, **jit_kwargs)
+
+
+def compile_records() -> List[Dict[str, Any]]:
+    """Every CompileRecord in this process, in compile order per function.
+
+    Process-lifetime (memoized engine callables outlive a single run), so
+    the manifest labels it accordingly.
+    """
+    with _REGISTRY_LOCK:
+        fns = list(_REGISTRY)
+    out: List[Dict[str, Any]] = []
+    for fn in fns:
+        out.extend(rec.as_dict() for rec in fn.records.values())
+    return out

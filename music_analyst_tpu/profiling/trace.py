@@ -1,0 +1,148 @@
+"""Device-time capture: profiler traces + a span-level Chrome trace.
+
+Promoted from ``metrics/tracing.py`` (now a deprecation shim).  Two
+granularities:
+
+* :func:`maybe_trace` / :func:`annotate` — the raw ``jax.profiler``
+  capture (HLO timelines, per-op device time) for TensorBoard/Perfetto,
+  unchanged semantics from the old module;
+* :func:`profile_run` — the ``--profile-dir`` flag's backing: wraps a run
+  in ``jax.profiler`` (tolerating tunnel failures — a dead axon must not
+  kill the analysis it was profiling) **and** renders this run's
+  telemetry spans into ``<dir>/trace_spans.json``, a self-contained
+  Chrome-trace artifact (``chrome://tracing`` / Perfetto) that works even
+  where the device-side profiler cannot.
+
+Timing discipline: wall timings everywhere come from forced
+``np.asarray`` readbacks at the engines' sync points, never
+``block_until_ready`` — the axon loopback tunnel does not reliably honor
+it (CLAUDE.md gotcha).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+@contextlib.contextmanager
+def maybe_trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """Capture a ``jax.profiler`` trace into ``trace_dir`` when set."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region that shows up on the profiler timeline."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def force_readback(value: Any) -> np.ndarray:
+    """Synchronize by materializing the bytes on the host.
+
+    THE timing barrier for this codebase: ``np.asarray`` forces the device
+    to produce the result before the clock reads, which
+    ``block_until_ready`` does not guarantee through the axon tunnel.
+    """
+    return np.asarray(value)
+
+
+def spans_to_chrome_trace(tel) -> Dict[str, Any]:
+    """Render a registry's recorded spans as Chrome-trace JSON.
+
+    Complete events (``ph: "X"``) on the monotonic clock, one ``tid`` per
+    thread name; span attributes ride along in ``args``.  Raw spans cap at
+    the registry's in-memory bound, so huge runs render their head — the
+    aggregate table in the manifest stays exact.
+    """
+    with tel._lock:
+        spans = list(tel.spans)
+    if spans:
+        base = min(sp.t_mono for sp in spans)
+    else:
+        base = 0.0
+    tids: Dict[str, int] = {}
+    events: List[Dict[str, Any]] = []
+    for sp in spans:
+        tid = tids.setdefault(sp.thread, len(tids) + 1)
+        event: Dict[str, Any] = {
+            "name": sp.name,
+            "ph": "X",
+            "ts": round((sp.t_mono - base) * 1e6, 3),
+            "dur": round(sp.duration_s * 1e6, 3),
+            "pid": 1,
+            "tid": tid,
+        }
+        if sp.attrs:
+            event["args"] = {k: str(v) for k, v in sp.attrs.items()}
+        events.append(event)
+    events.extend(
+        {"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+         "args": {"name": thread}}
+        for thread, tid in tids.items()
+    )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(tel, path: str) -> str:
+    payload = spans_to_chrome_trace(tel)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh)
+        fh.write("\n")
+    return path
+
+
+@contextlib.contextmanager
+def profile_run(profile_dir: Optional[str]) -> Iterator[None]:
+    """``--profile-dir``: device profiler capture + span Chrome trace.
+
+    The ``jax.profiler`` start/stop is best-effort (the device-side
+    profiler can refuse over a dead tunnel; the run must still produce its
+    analysis); the span-level ``trace_spans.json`` always lands because it
+    is rendered purely from host-side telemetry.
+    """
+    if not profile_dir:
+        yield
+        return
+    from music_analyst_tpu.telemetry import get_telemetry
+
+    tel = get_telemetry()
+    os.makedirs(profile_dir, exist_ok=True)
+    started = False
+    try:
+        import jax
+
+        jax.profiler.start_trace(profile_dir)
+        started = True
+    except Exception as exc:
+        tel.event("profiler_trace_unavailable", error=str(exc)[:200])
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception as exc:
+                tel.event("profiler_trace_stop_failed", error=str(exc)[:200])
+        try:
+            write_chrome_trace(
+                tel, os.path.join(profile_dir, "trace_spans.json")
+            )
+        except Exception as exc:
+            tel.event("span_trace_write_failed", error=str(exc)[:200])

@@ -402,6 +402,18 @@ class Telemetry:
         finally:
             if owner:
                 wall = time.monotonic() - (self._run_started_mono or 0.0)
+                # Per-stage collective table: one digestible event next to
+                # the per-call ``collective`` stream (and reset, so the
+                # next run starts clean).  Lazy import — collectives is
+                # jax-free but telemetry must not hard-require profiling.
+                try:
+                    from music_analyst_tpu.profiling.collectives import (
+                        emit_stage_table,
+                    )
+
+                    emit_stage_table()
+                except Exception:
+                    pass
                 with self._lock:
                     counters = dict(self.counters)
                     gauges = dict(self.gauges)

@@ -154,6 +154,18 @@ def write_run_manifest(
         "event_count": events,
         "telemetry_log": tel.sink_path,
     }
+    try:
+        # Process-lifetime compile records (memoized engine callables
+        # outlive a single run) — guarded so a jax-free manifest path or
+        # a partial install never blocks the write.
+        from music_analyst_tpu.profiling.compile import compile_records
+
+        manifest["profiling"] = {
+            "scope": "process",
+            "compiles": compile_records(),
+        }
+    except Exception:
+        pass
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, "run_manifest.json")
     with open(path, "w", encoding="utf-8") as fh:

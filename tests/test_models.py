@@ -318,10 +318,17 @@ class TestTensorParallel:
         )(sharded, ids_s, pos_s)
         ref_np, out_np = np.asarray(ref_logits), np.asarray(out)
         # bf16 all-reduce ordering differs across shards; demand near-total
-        # elementwise agreement plus identical argmax decisions.
+        # elementwise agreement plus identical argmax decisions wherever
+        # the decision isn't a near-tie (a reduction-order flip can
+        # legitimately swap a top-2 pair separated by less than bf16
+        # noise — the typical margin on this corpus is ~0.24).
         close = np.isclose(ref_np, out_np, rtol=3e-2, atol=3e-2)
         assert close.mean() > 0.999
-        assert (ref_np.argmax(-1) == out_np.argmax(-1)).mean() > 0.99
+        agree = ref_np.argmax(-1) == out_np.argmax(-1)
+        srt = np.sort(ref_np, axis=-1)
+        margin = srt[..., -1] - srt[..., -2]
+        assert agree[margin > 0.02].all(), margin[~agree]
+        assert agree.mean() > 0.95
 
     def test_partition_specs_cover_attention_and_mlp(self):
         cfg = LlamaConfig.tiny()

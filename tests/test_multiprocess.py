@@ -26,6 +26,11 @@ out_dir = sys.argv[4]
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 import jax
+# jaxlib >= 0.4.36 dropped the implicit multiprocess CPU emulation: cross-
+# process collectives on the CPU backend now need an explicit collectives
+# implementation or psum fails with "Multiprocess computations aren't
+# implemented on the CPU backend".  Gloo ships in-tree.
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 jax.distributed.initialize(
     coordinator_address=f"localhost:{port}", num_processes=2,
     process_id=proc_id,

@@ -5,14 +5,15 @@ PY ?= python
 
 .PHONY: smoke test native
 
-# Fast observability gate: profiling + telemetry unit tests, then one
-# smoke-shaped bench.py run through the full parent/child/--baseline
-# machinery, asserting the ONE-JSON-line stdout contract the round driver
-# depends on.  Runs in a couple of minutes on the sandboxed CPU.
+# Fast observability gate: profiling + telemetry + pipeline unit tests,
+# then one smoke-shaped bench.py run through the full parent/child/
+# --baseline machinery, asserting the ONE-JSON-line stdout contract the
+# round driver depends on, and finally a profile-diff self-check over two
+# smoke bench lines.  Runs in a few minutes on the sandboxed CPU.
 smoke:
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 		$(PY) -m pytest tests/test_profiling.py tests/test_telemetry.py \
-		tests/test_telemetry_contract.py -q
+		tests/test_telemetry_contract.py tests/test_runtime_pipeline.py -q
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= MUSICAAL_BENCH_SMOKE=1 \
 		$(PY) bench.py --baseline --attempts 1 --deadline 240 \
 		| $(PY) -c "import json,sys; \
@@ -21,6 +22,23 @@ assert len(lines)==1, f'expected ONE JSON line, got {len(lines)}'; \
 payload=json.loads(lines[0]); \
 assert 'vs_baseline_detail' in payload, 'missing --baseline detail'; \
 print('smoke ok:', payload['metric'], payload['value'])"
+	# profile-diff self-check: two smoke bench lines must both satisfy
+	# the one-line contract and feed the regression gate without an
+	# exit-2 (unusable input).  Exit 1 (regression verdict) is tolerated
+	# — smoke shapes on a 1-core sandbox are too noisy to gate on.
+	tmpdir=$$(mktemp -d) && trap 'rm -rf "$$tmpdir"' EXIT && \
+	for side in a b; do \
+		env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= MUSICAAL_BENCH_SMOKE=1 \
+			$(PY) bench.py --attempts 1 --deadline 240 \
+			> "$$tmpdir/$$side.json" || exit 1; \
+		test "$$(grep -c . "$$tmpdir/$$side.json")" = 1 || \
+			{ echo "bench $$side: not ONE JSON line"; exit 1; }; \
+	done && \
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+		$(PY) -m music_analyst_tpu profile-diff \
+		"$$tmpdir/a.json" "$$tmpdir/b.json" --threshold 0.5; rc=$$?; \
+	if [ $$rc -eq 2 ]; then echo "profile-diff: unusable input"; exit 1; \
+	else echo "profile-diff self-check ok (exit $$rc)"; fi
 
 test:
 	$(PY) -m pytest tests/ -q

@@ -177,19 +177,32 @@ def measure() -> dict:
     with tel.span("warmup", rows=batch):
         clf.classify_batch(texts[:batch])
 
-    # One-deep host/device pipeline: tokenize batch i+1 while batch i runs.
+    # Bounded prefetch pipeline (runtime/prefetch.py — replaces the old
+    # hand-rolled one-deep loop): tokenize and transfer stages run up to
+    # ``depth`` batches ahead of the device; collect() in the consumer is
+    # an np.asarray readback — reliable on axon.
+    from music_analyst_tpu.runtime import (
+        PrefetchPipeline,
+        Stage,
+        resolve_prefetch_depth,
+    )
+
+    pipe = PrefetchPipeline(
+        [
+            Stage("tokenize", clf.prepare),
+            Stage("h2d", lambda p: clf.launch(clf.transfer(p))),
+        ],
+        depth=resolve_prefetch_depth(),
+        name="pipeline",
+        sink_name="compute",
+    )
+    batches = (
+        texts[i : i + batch] for i in range(0, len(texts), batch)
+    )
     start = time.perf_counter()
-    done = 0
-    pending = None
     with tel.span("measure", rows=len(texts)):
-        while done < len(texts):
-            handle = clf.submit(texts[done : done + batch])
-            if pending is not None:
-                clf.collect(pending)
-            pending = handle
-            done += batch
-        if pending is not None:
-            clf.collect(pending)  # np.asarray readback — reliable on axon
+        for handle in pipe.run(batches):
+            clf.collect(handle)
     elapsed = time.perf_counter() - start
 
     songs_per_sec = len(texts) / elapsed

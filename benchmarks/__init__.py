@@ -33,6 +33,7 @@ _SUITE_MODULES = (
     "benchmarks.llama_zeroshot",
     "benchmarks.sentiment_int8",
     "benchmarks.bucketing",
+    "benchmarks.overlap",
 )
 
 

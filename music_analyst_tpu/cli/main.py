@@ -97,6 +97,10 @@ def _add_analyze(sub: argparse._SubParsersAction) -> None:
                    help="Keyword-kernel sentiment for --with-sentiment")
     p.add_argument("--batch-size", type=int, default=4096,
                    help="Sentiment batch size for --with-sentiment")
+    p.add_argument("--prefetch-depth", type=int, default=None,
+                   help="Sentiment batches staged ahead of the device in "
+                        "the tokenize→transfer pipeline (default 2, or "
+                        "$MUSICAAL_PREFETCH_DEPTH; 0 = no overlap)")
     _add_telemetry_flags(p)
 
 
@@ -127,6 +131,10 @@ def _add_sentiment(sub: argparse._SubParsersAction) -> None:
                         "32,64,128) or 'auto' to derive them from the "
                         "corpus; short songs run at shorter sequence "
                         "lengths")
+    p.add_argument("--prefetch-depth", type=int, default=None,
+                   help="Batches staged ahead of the device in the "
+                        "tokenize→transfer pipeline (default 2, or "
+                        "$MUSICAAL_PREFETCH_DEPTH; 0 = no overlap)")
     _add_telemetry_flags(p)
 
 
@@ -304,6 +312,7 @@ def _dispatch(parser: argparse.ArgumentParser,
                     mesh=mesh,
                     write_split=not args.no_split,
                     ingest_backend=args.ingest,
+                    prefetch_depth=args.prefetch_depth,
                 )
             return 0
         from music_analyst_tpu.engines.wordcount import run_analysis
@@ -357,6 +366,7 @@ def _dispatch(parser: argparse.ArgumentParser,
                 resume=args.resume,
                 mesh=mesh,
                 length_buckets=args.length_buckets,
+                prefetch_depth=args.prefetch_depth,
             )
         return 0
 

@@ -51,6 +51,7 @@ def run_joint(
     write_split: bool = True,
     ingest_backend: str = "auto",
     quiet: bool = False,
+    prefetch_depth: Optional[int] = None,
 ) -> JointResult:
     from music_analyst_tpu.telemetry import get_telemetry
 
@@ -61,12 +62,14 @@ def run_joint(
         return _run_joint_impl(
             dataset_path, output_dir, model, mock, word_limit, artist_limit,
             limit, batch_size, mesh, write_split, ingest_backend, quiet,
+            prefetch_depth,
         )
 
 
 def _run_joint_impl(
     dataset_path, output_dir, model, mock, word_limit, artist_limit,
     limit, batch_size, mesh, write_split, ingest_backend, quiet,
+    prefetch_depth,
 ) -> JointResult:
     timer = StageTimer()
     with timer.stage("ingest"):
@@ -99,6 +102,7 @@ def _run_joint_impl(
             quiet=quiet,
             songs=corpus.iter_records(),
             mesh=mesh,
+            prefetch_depth=prefetch_depth,
         )
     total = timer.total("ingest", "wordcount", "sentiment")
     songs_per_second = analysis.total_songs / total if total > 0 else 0.0

@@ -20,16 +20,16 @@ shape as the sentiment engine's batch pipeline.
 
 from __future__ import annotations
 
+import contextlib
 import csv
 import os
-from collections import deque
-from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 from music_analyst_tpu.data.csv_io import sniff_delimiter
 from music_analyst_tpu.data.tokenizer import tokenize_latin1
+from music_analyst_tpu.runtime import PrefetchPipeline, Stage
 from music_analyst_tpu.telemetry import get_telemetry
 
 # Rows per pool task.  Large enough to amortize future/queue overhead,
@@ -183,8 +183,7 @@ def _persong_stream(
                 "CSV is missing expected columns: " + ", ".join(sorted(missing))
             )
 
-        with open(per_song_path, "w", encoding="utf-8", newline="") as ps_fh, \
-                ThreadPoolExecutor(max_workers=n_workers) as pool:
+        with open(per_song_path, "w", encoding="utf-8", newline="") as ps_fh:
             by_song = csv.writer(ps_fh)
             by_song.writerow(["artist", "song", "word", "count"])
 
@@ -199,15 +198,30 @@ def _persong_stream(
                         histogram.add(word, count)
                         by_song.writerow([artist, song, word, count])
 
-            # Bounded submit/collect window: tokenization overlaps the
-            # fold+write, results land strictly in submission order.
-            window: deque = deque()
-            for chunk in _iter_chunks(reader, _CHUNK_ROWS):
-                window.append(pool.submit(_tokenize_chunk, chunk))
-                if len(window) > n_workers * _WINDOW_PER_WORKER:
-                    fold(window.popleft().result())
-            while window:
-                fold(window.popleft().result())
+            # Shared bounded pipeline (runtime/prefetch.py) with a
+            # multi-worker tokenize stage — same semantics the old
+            # hand-rolled deque window had: tokenization overlaps the
+            # fold+write, results land strictly in submission order, at
+            # most workers×2 chunks in flight.  _tokenize_chunk records
+            # its own "tokenize" spans → record_spans=False here.
+            pipe = PrefetchPipeline(
+                [
+                    Stage(
+                        "tokenize", _tokenize_chunk,
+                        workers=n_workers, record_spans=False,
+                    )
+                ],
+                depth=_WINDOW_PER_WORKER,
+                name="persong",
+                sink_name="fold",
+            )
+            # closing(): the pipeline must be cancelled and joined before
+            # the reader's file handle goes away.
+            with contextlib.closing(
+                pipe.run(_iter_chunks(reader, _CHUNK_ROWS))
+            ) as results:
+                for chunk_result in results:
+                    fold(chunk_result)
 
     with tel.span("write", rows=total_rows), \
             open(global_path, "w", encoding="utf-8", newline="") as g_fh:

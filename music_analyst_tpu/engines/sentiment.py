@@ -16,14 +16,20 @@ Outputs are byte-for-byte the reference artifact formats:
 
 from __future__ import annotations
 
+import contextlib
 import csv
 import dataclasses
 import json
 import os
 import time
-from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from music_analyst_tpu.data.csv_io import iter_songs
+from music_analyst_tpu.runtime import (
+    PrefetchPipeline,
+    Stage,
+    resolve_prefetch_depth,
+)
 from music_analyst_tpu.telemetry import get_telemetry
 from music_analyst_tpu.utils.labels import SUPPORTED_LABELS
 
@@ -59,11 +65,35 @@ class ClassifierBackend:
         """Labels for a batch of raw lyric strings."""
         raise NotImplementedError
 
-    # Async pair for host/device pipelining: ``submit`` should do host work
-    # (tokenize) and *dispatch* device work without blocking; ``collect``
-    # blocks on the result.  Device backends override these so the engine
-    # can tokenize batch i+1 while batch i runs on the chips.  The default
-    # is synchronous.
+    # Staged hooks for the host↔device prefetch pipeline
+    # (music_analyst_tpu/runtime/prefetch.py).  The engine runs
+    # ``prepare`` (host tokenize + batch planning), ``transfer``
+    # (``jax.device_put`` of the wire payload), and ``launch`` (dispatch
+    # the jitted forwards without blocking) in separate pipeline stages,
+    # then blocks on ``collect`` in the consumer — so batch i+2 tokenizes
+    # and batch i+1 transfers while batch i runs on the chips.  The
+    # defaults collapse the three stages into ``submit``, so a backend
+    # that only implements submit/collect (or just classify_batch) works
+    # unchanged — the pipeline simply gets no tokenize/transfer overlap
+    # from it.
+    def prepare(self, texts: Sequence[str]):
+        """Host-only work: tokenize + plan the batch.  Must not touch the
+        device."""
+        return texts
+
+    def transfer(self, prepared):
+        """Ship the prepared payload host→device (``jax.device_put``)."""
+        return prepared
+
+    def launch(self, transferred):
+        """Dispatch device work for a transferred payload; returns the
+        handle ``collect`` blocks on."""
+        return self.submit(transferred)
+
+    # Async pair kept as the single-call surface: ``submit`` does the host
+    # work and dispatches device work without blocking; ``collect`` blocks
+    # on the result.  Backends that implement the staged hooks above
+    # compose them here so direct submit/collect callers see one behavior.
     def submit(self, texts: Sequence[str]):
         return self.classify_batch(texts)
 
@@ -229,6 +259,7 @@ def run_sentiment(
     songs: Optional[Iterable[Tuple[str, str, str]]] = None,
     mesh=None,
     length_buckets: Optional[Sequence[int]] = None,
+    prefetch_depth: Optional[int] = None,
 ) -> SentimentResult:
     """Classify the dataset and write the reference output artifacts.
 
@@ -242,6 +273,11 @@ def run_sentiment(
     ``(artist, song, text)`` rows — the fused joint pipeline passes the
     records its single ingest captured, so the file is opened once per run
     (``limit`` is ignored then; the producer already applied it).
+
+    ``prefetch_depth`` bounds how many batches ride ahead of the device in
+    the tokenize→transfer pipeline (``--prefetch-depth``; default 2 via
+    ``$MUSICAAL_PREFETCH_DEPTH``); 0 disables overlap entirely.  Output
+    artifacts are byte-identical at every depth — only wall time changes.
     """
     if songs is not None and resume:
         # The resume skip count indexes the DictReader row order of a prior
@@ -255,6 +291,7 @@ def run_sentiment(
         return _run_sentiment_impl(
             tel, dataset_path, model, mock, limit, output_dir, batch_size,
             backend, quiet, resume, songs, mesh, length_buckets,
+            prefetch_depth,
         )
 
 
@@ -280,8 +317,10 @@ def _timed_source(tel, source):
 def _run_sentiment_impl(
     tel, dataset_path, model, mock, limit, output_dir, batch_size,
     backend, quiet, resume, songs, mesh, length_buckets,
+    prefetch_depth,
 ) -> SentimentResult:
     os.makedirs(output_dir, exist_ok=True)
+    depth = resolve_prefetch_depth(prefetch_depth)
     if backend is None:
         # Every built-in backend compiles device programs (the mock path
         # included — its keyword kernel is jitted), so enable the
@@ -306,7 +345,7 @@ def _run_sentiment_impl(
             clf = get_backend(
                 model, mock=mock, mesh=mesh, length_buckets=length_buckets
             )
-    tel.annotate(backend=clf.name, batch_size=batch_size)
+    tel.annotate(backend=clf.name, batch_size=batch_size, prefetch_depth=depth)
 
     totals_path = os.path.join(output_dir, "sentiment_totals.json")
     details_path = os.path.join(output_dir, "sentiment_details.csv")
@@ -327,12 +366,6 @@ def _run_sentiment_impl(
     )
     if not skip:
         writer.writeheader()
-
-    batch: List[Tuple[str, str, str]] = []
-    # One-deep pipeline: while batch i runs on device, batch i+1 tokenizes
-    # on the host (the reference is strictly serial, one HTTP call per song,
-    # SURVEY.md §3.2).
-    in_flight: Optional[Tuple[List[Tuple[str, str, str]], Any, float]] = None
 
     def finish(rows_batch, handle, t_submit, measured) -> None:
         with tel.span("compute", rows=len(rows_batch)):
@@ -368,40 +401,62 @@ def _run_sentiment_impl(
                 )
             details_fh.flush()
 
-    def flush() -> None:
-        nonlocal in_flight, batch
-        if not batch:
-            return
-        texts = [text for _, _, text in batch]
-        t0 = time.perf_counter()
-        # "tokenize": the host half of submit() (tokenization + dispatch);
-        # device time is the async tail collected under "compute".
-        with tel.span("tokenize", rows=len(texts)):
-            handle = clf.submit(texts)
-        # Snapshot measured latencies NOW: synchronous backends (Ollama)
-        # classify inside submit() and overwrite last_latencies on the
-        # next submit, which would mis-attribute them across batches.
-        measured = getattr(clf, "last_latencies", None)
-        pending = (batch, handle, t0, list(measured) if measured else None)
-        batch = []
-        if in_flight is not None:
-            finish(*in_flight)
-        in_flight = pending
+    def batches(source):
+        batch: List[Tuple[str, str, str]] = []
+        for idx, row in enumerate(source):
+            if idx < skip:
+                continue
+            batch.append(row)
+            if len(batch) >= batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
 
+    # Duck-typed backends (test doubles, user plugins) predate the staged
+    # hooks — the historical floor is submit/collect, so missing hooks
+    # degrade to that exact behavior: everything happens in the launch
+    # stage, with no tokenize/transfer overlap.
+    clf_prepare = getattr(clf, "prepare", None) or (lambda texts: texts)
+    clf_transfer = getattr(clf, "transfer", None) or (lambda prepared: prepared)
+    clf_launch = getattr(clf, "launch", None) or clf.submit
+
+    def tokenize_stage(rows_batch):
+        # Host half only: tokenization + batch planning.  Device dispatch
+        # happens downstream so a slow tokenizer can't serialize the chip.
+        texts = [text for _, _, text in rows_batch]
+        return rows_batch, clf_prepare(texts)
+
+    def h2d_stage(item):
+        rows_batch, prepared = item
+        t0 = time.perf_counter()
+        handle = clf_launch(clf_transfer(prepared))
+        # Snapshot measured latencies NOW: synchronous backends (Ollama)
+        # classify inside launch() and overwrite last_latencies on the
+        # next launch, which would mis-attribute them across batches.
+        measured = getattr(clf, "last_latencies", None)
+        return rows_batch, handle, t0, list(measured) if measured else None
+
+    # Replaces the old hand-rolled one-deep submit/collect overlap: up to
+    # ``depth`` batches tokenize and transfer ahead of the device, each hop
+    # bounded (backpressure), stalls accounted per stage (the reference is
+    # strictly serial, one HTTP call per song, SURVEY.md §3.2).
+    pipe = PrefetchPipeline(
+        [Stage("tokenize", tokenize_stage), Stage("h2d", h2d_stage)],
+        depth=depth,
+        name="pipeline",
+        sink_name="compute",
+    )
     source = _timed_source(
         tel,
         songs if songs is not None else iter_songs(dataset_path, limit=limit),
     )
     try:
-        for idx, (artist, song, text) in enumerate(source):
-            if idx < skip:
-                continue
-            batch.append((artist, song, text))
-            if len(batch) >= batch_size:
-                flush()
-        flush()
-        if in_flight is not None:
-            finish(*in_flight)
+        # closing(): a collect()/write error below must cancel and join the
+        # pipeline threads, not leave them prefetching into a dead run.
+        with contextlib.closing(pipe.run(batches(source))) as results:
+            for rows_batch, handle, t_submit, measured in results:
+                finish(rows_batch, handle, t_submit, measured)
     finally:
         details_fh.close()
     wall = time.perf_counter() - start

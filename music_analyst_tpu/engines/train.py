@@ -239,8 +239,13 @@ def make_train_step(model, optimizer, mesh: Optional[Mesh] = None):
     from music_analyst_tpu.profiling.compile import profiled_jit
 
     if mesh is None:
+        # Donate the incoming state: state-in and state-out are the same
+        # pytree of shapes, so params + both Adam moments update in place
+        # instead of holding two full copies live across the step.  Callers
+        # must reassign (`state, loss = step(state, ...)`) — every loop in
+        # this repo does, and the donated buffers error loudly if reused.
         return _with_step_telemetry(
-            profiled_jit(step_fn, name="train_step")
+            profiled_jit(step_fn, name="train_step", donate_argnums=(0,))
         )
 
     data_axes = [a for a in ("dp", "sp") if a in mesh.axis_names]
@@ -295,9 +300,13 @@ def make_train_step(model, optimizer, mesh: Optional[Mesh] = None):
             )
             jitted = jitted_by_layout.get(key)
             if jitted is None:
+                # donate_argnums=(0,): the output state is pinned to the
+                # input state's shardings, so every leaf aliases exactly —
+                # in-place update, halving peak optimizer memory.
                 jitted = profiled_jit(
                     sharded_step, name="train_step_sharded",
                     out_shardings=(shardings, None),
+                    donate_argnums=(0,),
                 )
                 jitted_by_layout[key] = jitted
         new_state, loss = jitted(state, token_ids, lengths, segment_ids)
@@ -305,3 +314,61 @@ def make_train_step(model, optimizer, mesh: Optional[Mesh] = None):
         return new_state, loss
 
     return _with_step_telemetry(pinned_step)
+
+
+def prefetch_batches(batches, mesh: Optional[Mesh] = None, depth=None):
+    """Device-put training batches up to ``depth`` ahead of the step loop.
+
+    ``batches`` yields ``(token_ids, lengths)`` or ``(token_ids, lengths,
+    segment_ids)`` host arrays; each comes back with lengths/segment ids
+    narrowed to int16 where the sequence length allows (they widen inside
+    the loss) and every array already placed — sharded ``P('dp','sp')``
+    when a mesh is given — so the train loop's ``jitted(state, *batch)``
+    never blocks on the ~10 MB/s H2D tunnel.  The transfer overlaps the
+    previous step's device time through the shared bounded pipeline
+    (``runtime/prefetch.py``); stalls land in the manifest's ``pipeline``
+    section under ``train_pipeline``.
+    """
+    from music_analyst_tpu.runtime import (
+        PrefetchPipeline,
+        Stage,
+        resolve_prefetch_depth,
+    )
+    from music_analyst_tpu.runtime.wire import count_h2d_bytes, narrow_lengths
+
+    depth = resolve_prefetch_depth(depth)
+    if mesh is not None:
+        data_axes = [a for a in ("dp", "sp") if a in mesh.axis_names]
+        dp = data_axes[0] if data_axes else None
+        sp = data_axes[1] if len(data_axes) > 1 else None
+        batch_sharding = NamedSharding(mesh, P(dp, sp))
+        lengths_sharding = NamedSharding(mesh, P(dp))
+    else:
+        batch_sharding = lengths_sharding = None
+
+    def h2d(batch):
+        token_ids, lengths, *rest = batch
+        segment_ids = rest[0] if rest else None
+        S = token_ids.shape[1]
+        lengths = narrow_lengths(lengths, S)
+        arrays = [token_ids, lengths]
+        shardings = [batch_sharding, lengths_sharding]
+        if segment_ids is not None:
+            # Contiguous per-row document ids are bounded by S.
+            arrays.append(narrow_lengths(segment_ids, S))
+            shardings.append(batch_sharding)
+        count_h2d_bytes(arrays, prefix="train_pipeline")
+        placed = tuple(
+            jax.device_put(a, s) for a, s in zip(arrays, shardings)
+        )
+        if segment_ids is None and rest:
+            return (*placed, None)
+        return placed
+
+    pipe = PrefetchPipeline(
+        [Stage("h2d", h2d)],
+        depth=depth,
+        name="train_pipeline",
+        sink_name="step",
+    )
+    return pipe.run(iter(batches))

@@ -8,11 +8,20 @@ Chrome trace, and :func:`force_readback`).
 
 from __future__ import annotations
 
+import warnings
+
 from music_analyst_tpu.profiling.trace import (  # noqa: F401
     annotate,
     force_readback,
     maybe_trace,
     profile_run,
+)
+
+warnings.warn(
+    "music_analyst_tpu.metrics.tracing is deprecated; import from "
+    "music_analyst_tpu.profiling.trace instead",
+    DeprecationWarning,
+    stacklevel=2,
 )
 
 __all__ = ["annotate", "force_readback", "maybe_trace", "profile_run"]

@@ -431,16 +431,28 @@ class DistilBertClassifier(ClassifierBackend):
         self.mesh = mesh
 
         from music_analyst_tpu.profiling.compile import profiled_jit
+        from music_analyst_tpu.runtime.wire import forward_donation_kwargs
 
         def _forward(params, token_ids, lengths):
-            # ids may arrive int16 (see _wire_dtype) — widen on device.
+            # ids/lengths may arrive int16 (see _wire_dtype/_index_dtype)
+            # — widen on device.
             logits = self.model.apply(
-                {"params": params}, token_ids.astype(jnp.int32), lengths
+                {"params": params},
+                token_ids.astype(jnp.int32),
+                lengths.astype(jnp.int32),
             )
             probs = jax.nn.softmax(logits, axis=-1)
             return jnp.argmax(logits, axis=-1), jnp.max(probs, axis=-1)
 
-        self._forward = profiled_jit(_forward, name="distilbert_forward")
+        # Steady-state forwards donate their input batch: the H2D staging
+        # buffer is dead the moment the widened copy exists, so XLA may
+        # reuse its space for temporaries instead of pinning ~depth+1
+        # staged batches live across the step (no-op on the CPU test mesh,
+        # see forward_donation_kwargs).
+        self._forward = profiled_jit(
+            _forward, name="distilbert_forward",
+            **forward_donation_kwargs(1, 2),
+        )
 
         def _forward_packed(params, token_ids, starts, row_len):
             """Packed rows: expand the compact per-segment wire format
@@ -476,7 +488,8 @@ class DistilBertClassifier(ClassifierBackend):
             return jnp.argmax(logits, axis=-1), jnp.max(probs, axis=-1)
 
         self._forward_packed = profiled_jit(
-            _forward_packed, name="distilbert_forward_packed"
+            _forward_packed, name="distilbert_forward_packed",
+            **forward_donation_kwargs(1, 2, 3),
         )
         # Host→device transfer rides a ~10 MB/s tunnel in this environment
         # (roofline suite); token ids are the payload, and every BERT-sized
@@ -594,24 +607,22 @@ class DistilBertClassifier(ClassifierBackend):
                 payload_bytes=(rows // dp) * 8, n_devices=dp, axis="dp",
             )
 
-    def _dispatch(self, token_ids: np.ndarray, lengths: np.ndarray):
-        """Pad for the dp axis, place, and launch one forward (async)."""
+    def _plan_flat(self, token_ids: np.ndarray, lengths: np.ndarray):
+        """Host-side plan for one full-width forward: pad for the dp axis
+        and cast to wire dtypes.  ``(gather, n, arrays)`` — no device."""
+        from music_analyst_tpu.runtime.wire import narrow_lengths
+
         token_ids, lengths, n = self._pad_batch(token_ids, lengths)
         token_ids = np.asarray(token_ids, dtype=self._wire_dtype)
-        if self._data_sharding is not None:
-            token_ids = jax.device_put(token_ids, self._data_sharding)
-            lengths = jax.device_put(lengths, self._data_sharding)
-        self._record_mesh_collectives(*token_ids.shape)
-        classes, confidence = self._forward(self.params, token_ids, lengths)
-        return classes, confidence, n
+        lengths = narrow_lengths(lengths, self.max_len)
+        return None, n, (token_ids, lengths)
 
-    def _submit_packed(self, token_ids: np.ndarray, lengths: np.ndarray):
-        """Pack lyrics into shared rows and dispatch one forward.
-
-        Row and slot counts round to powers of two (shapes stay bounded);
-        the part carries the ``(bin_of, slot_of)`` gather map back to
-        :meth:`collect`.
-        """
+    def _plan_packed(self, token_ids: np.ndarray, lengths: np.ndarray):
+        """Host-side plan for packed rows: bin-pack lyrics into shared
+        rows, cast the compact wire format.  Row and slot counts round to
+        powers of two (shapes stay bounded); the plan carries the
+        ``(bin_of, slot_of)`` gather map back to :meth:`collect`."""
+        from music_analyst_tpu.runtime.wire import narrow_lengths
         from music_analyst_tpu.utils.shapes import round_pow2
 
         n = token_ids.shape[0]
@@ -635,18 +646,12 @@ class DistilBertClassifier(ClassifierBackend):
                 i, : lengths[i]
             ]
         ids = np.asarray(ids, dtype=self._wire_dtype)
-        st = np.asarray(st, dtype=self._index_dtype)
-        rl = np.asarray(rl, dtype=self._index_dtype)
-        if self._data_sharding is not None:
-            ids = jax.device_put(ids, self._data_sharding)
-            st = jax.device_put(st, self._data_sharding)
-            rl = jax.device_put(rl, self._data_sharding)
-        self._record_mesh_collectives(rows_padded, self.max_len)
-        classes, confidence = self._forward_packed(self.params, ids, st, rl)
-        return [((bin_of, slot_of), classes, confidence, n)]
+        st = narrow_lengths(st, self.max_len)
+        rl = narrow_lengths(rl, self.max_len)
+        return [((bin_of, slot_of), n, (ids, st, rl))]
 
-    def submit(self, texts: Sequence[str]):
-        """Tokenize + dispatch without blocking (JAX async dispatch).
+    def prepare(self, texts: Sequence[str]):
+        """Host phase: tokenize and plan the batch (no device work).
 
         With ``length_buckets`` set, rows group by token length and each
         group runs at the smallest sufficient sequence length (seq-32 rows
@@ -657,10 +662,14 @@ class DistilBertClassifier(ClassifierBackend):
         fewer, fuller rows.  Row counts round up to powers of two so the
         compiled-shape set stays bounded; original order is restored in
         :meth:`collect`.
+
+        Returns ``(texts, [(gather, n, host_arrays)...])`` — every array
+        already padded and cast to its wire dtype, ready for
+        :meth:`transfer`.
         """
         token_ids, lengths = self.tokenizer.encode_batch(texts, self.max_len)
         if self.packed:
-            return texts, self._submit_packed(token_ids, lengths)
+            return texts, self._plan_packed(token_ids, lengths)
         if self.length_buckets == "auto" and lengths.size:
             # First non-empty batch is the sample: at production batch
             # sizes (4-8k rows) its length distribution is the corpus's.
@@ -672,7 +681,7 @@ class DistilBertClassifier(ClassifierBackend):
         if self.length_buckets == "auto":
             return texts, []
         if self.length_buckets is None:
-            return texts, [(None, *self._dispatch(token_ids, lengths))]
+            return texts, [self._plan_flat(token_ids, lengths)]
         parts = []
         remaining = np.arange(token_ids.shape[0])
         for bucket in self.length_buckets:
@@ -686,9 +695,54 @@ class DistilBertClassifier(ClassifierBackend):
             len_b = np.ones((padded_rows,), lengths.dtype)
             ids_b[: rows.size] = token_ids[rows, :bucket]
             len_b[: rows.size] = lengths[rows]
-            classes, confidence, _ = self._dispatch(ids_b, len_b)
-            parts.append((rows, classes, confidence, rows.size))
+            _, _, arrays = self._plan_flat(ids_b, len_b)
+            parts.append((rows, rows.size, arrays))
         return texts, parts
+
+    def transfer(self, prepared):
+        """H2D phase: place every planned wire array on device.
+
+        Runs in the pipeline's transfer stage so batch i+1 crosses the
+        ~10 MB/s tunnel while batch i computes.  Bytes shipped (and saved
+        vs an int32 wire) land in the ``pipeline.h2d_bytes*`` counters.
+        """
+        from music_analyst_tpu.runtime.wire import count_h2d_bytes
+
+        texts, parts = prepared
+        placed = []
+        for gather, n, arrays in parts:
+            count_h2d_bytes(arrays)
+            arrays = tuple(
+                jax.device_put(a, self._data_sharding) for a in arrays
+            )
+            placed.append((gather, n, arrays))
+        return texts, placed
+
+    def launch(self, transferred):
+        """Dispatch phase: launch the jitted forwards (JAX async dispatch
+        — returns handles, never blocks on results)."""
+        texts, parts = transferred
+        launched = []
+        for gather, n, arrays in parts:
+            if len(arrays) == 2:
+                token_ids, lengths = arrays
+                self._record_mesh_collectives(*token_ids.shape)
+                classes, confidence = self._forward(
+                    self.params, token_ids, lengths
+                )
+            else:
+                ids, st, rl = arrays
+                self._record_mesh_collectives(ids.shape[0], self.max_len)
+                classes, confidence = self._forward_packed(
+                    self.params, ids, st, rl
+                )
+            launched.append((gather, classes, confidence, n))
+        return texts, launched
+
+    def submit(self, texts: Sequence[str]):
+        """Tokenize + dispatch without blocking: the staged hooks composed
+        for direct submit/collect callers."""
+        return self.launch(self.transfer(self.prepare(texts)))
 
     def collect(self, handle) -> List[str]:
         texts, parts = handle

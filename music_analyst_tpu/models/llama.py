@@ -441,6 +441,9 @@ class LlamaZeroShotClassifier(ClassifierBackend):
             """
             B, S = prompt_ids.shape
             n_labels, L = label_ids.shape
+            # prompt_lens may arrive int16 (wire narrowing) — widen once
+            # on device before the arithmetic/broadcast uses below.
+            prompt_lens = prompt_lens.astype(jnp.int32)
             positions = jnp.arange(S)[None, :].repeat(B, 0)
             # kv length is S+L (the cache buffer); the label slots are
             # causally unreachable during prefill and masked out anyway.
@@ -631,6 +634,15 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         if self.decode_mode == "generate":
             return self.classify_batch_by_generation(texts)
         prompt_ids, prompt_lens = self._encode_prompts(texts)
+        # Prompt lengths cross the wire int16 (llama's 128k vocab keeps the
+        # ids themselves int32); widened on device in _score_labels.
+        from music_analyst_tpu.runtime.wire import (
+            count_h2d_bytes,
+            narrow_lengths,
+        )
+
+        prompt_lens = narrow_lengths(prompt_lens, self.max_prompt_len)
+        count_h2d_bytes([prompt_ids, prompt_lens])
         scores = np.asarray(
             self._score_labels(
                 self.params,

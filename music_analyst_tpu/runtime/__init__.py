@@ -1,0 +1,42 @@
+"""Host↔device data-plane runtime.
+
+The reusable substrate every engine dispatches batches through:
+
+* :mod:`prefetch` — the bounded-depth staged pipeline executor
+  (tokenize → transfer → compute overlap with backpressure, stall
+  accounting, clean cancellation/exception propagation);
+* :mod:`wire` — H2D payload narrowing (int16 lengths, packed-uint8
+  masks), byte accounting, and ``donate_argnums`` policy for the
+  steady-state jitted forwards.
+
+Zero hard deps on jax at import time (``wire`` lazy-imports it inside
+the device-facing helpers), matching the telemetry package's rule: this
+module must be importable before ``tests/conftest.py`` forces the CPU
+platform.
+"""
+
+from music_analyst_tpu.runtime.prefetch import (  # noqa: F401
+    DEFAULT_PREFETCH_DEPTH,
+    PrefetchPipeline,
+    Stage,
+    resolve_prefetch_depth,
+)
+from music_analyst_tpu.runtime.wire import (  # noqa: F401
+    count_h2d_bytes,
+    forward_donation_kwargs,
+    narrow_lengths,
+    pack_mask,
+    unpack_mask,
+)
+
+__all__ = [
+    "DEFAULT_PREFETCH_DEPTH",
+    "PrefetchPipeline",
+    "Stage",
+    "resolve_prefetch_depth",
+    "count_h2d_bytes",
+    "forward_donation_kwargs",
+    "narrow_lengths",
+    "pack_mask",
+    "unpack_mask",
+]

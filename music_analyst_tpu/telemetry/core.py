@@ -150,6 +150,7 @@ class Telemetry:
         self.span_aggregates: Dict[str, List[float]] = {}  # name -> [n, total, max]
         self.context: Dict[str, Any] = {}  # annotate() → manifest fields
         self.jax_events: Dict[str, List[float]] = {}  # key -> [n, total_s]
+        self.pipelines: Dict[str, Any] = {}  # record_pipeline() → manifest
         self.events = 0
         self._sink = None
         self._sink_path: Optional[str] = None
@@ -302,6 +303,22 @@ class Telemetry:
             payload["attrs"] = attrs
         self._emit(payload)
 
+    def record_pipeline(self, name: str, summary: Dict[str, Any]) -> None:
+        """Store a prefetch pipeline's end-of-run stats (depth, per-stage
+        stall/backpressure seconds, queue-depth high-water marks) under its
+        pipeline name — the run manifest's ``pipeline`` section.  A name
+        reused within one run (e.g. a sweep looping an engine) keeps the
+        latest stats; the per-pipeline gauges/spans retain the history.
+        """
+        if not self.enabled:
+            return
+        with self._lock:
+            self.pipelines[name] = summary
+
+    def pipeline_summary(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self.pipelines)
+
     def annotate(self, **context: Any) -> None:
         """Attach run-level context (mesh shape, backend name, …) that the
         manifest should carry verbatim."""
@@ -344,12 +361,21 @@ class Telemetry:
         ]
 
     def summary(self, top: int = 3) -> Dict[str, Any]:
-        """Compact JSON-able digest (bench.py's ``telemetry`` sub-object)."""
-        return {
+        """Compact JSON-able digest (bench.py's ``telemetry`` sub-object).
+
+        The ``pipeline`` key appears only when a prefetch pipeline ran —
+        runs without one keep the original three-key shape
+        (tests/test_telemetry_contract.py pins it).
+        """
+        out = {
             "events": self.events,
             "top_spans": self.top_spans(top),
             "compile": self.compile_stats(),
         }
+        pipelines = self.pipeline_summary()
+        if pipelines:
+            out["pipeline"] = pipelines
+        return out
 
     # ---------------------------------------------------------- run scope
 

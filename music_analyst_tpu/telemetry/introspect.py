@@ -133,6 +133,7 @@ def write_run_manifest(
             for k, (n, t) in sorted(tel.jax_events.items())
         }
         events = tel.events
+        pipelines = dict(tel.pipelines)
     manifest: Dict[str, Any] = {
         "schema": 1,
         "engine": context.pop("engine", None),
@@ -151,6 +152,7 @@ def write_run_manifest(
         "gauges": gauges,
         "histograms": histograms,
         "spans": tel.top_spans(n=20),
+        "pipeline": pipelines,
         "event_count": events,
         "telemetry_log": tel.sink_path,
     }

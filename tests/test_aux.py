@@ -60,7 +60,7 @@ def test_checkpoint_roundtrip(tmp_path):
 
 def test_tracing_context(tmp_path):
     import jax
-    from music_analyst_tpu.metrics.tracing import annotate, maybe_trace
+    from music_analyst_tpu.profiling.trace import annotate, maybe_trace
 
     with maybe_trace(str(tmp_path / "trace")):
         with annotate("unit-test-region"):

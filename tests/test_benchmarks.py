@@ -21,6 +21,7 @@ def test_every_advertised_module_registers(monkeypatch):
     for expected in (
         "roofline", "flash_sweep", "generation", "coldstart", "ingest",
         "scaling", "joint", "llama_zeroshot", "sentiment_int8", "bucketing",
+        "overlap",
     ):
         assert expected in names
 
@@ -28,7 +29,7 @@ def test_every_advertised_module_registers(monkeypatch):
 @pytest.mark.parametrize(
     "name",
     ["roofline", "flash_sweep", "generation", "ingest", "joint",
-     "llama_zeroshot", "sentiment_int8", "bucketing"],
+     "llama_zeroshot", "sentiment_int8", "bucketing", "overlap"],
 )
 def test_suite_runs_smoke(name, monkeypatch):
     monkeypatch.setenv("MUSICAAL_BENCH_SMOKE", "1")

@@ -5,15 +5,17 @@ PY ?= python
 
 .PHONY: smoke test native
 
-# Fast observability gate: profiling + telemetry + pipeline unit tests,
-# then one smoke-shaped bench.py run through the full parent/child/
-# --baseline machinery, asserting the ONE-JSON-line stdout contract the
-# round driver depends on, and finally a profile-diff self-check over two
-# smoke bench lines.  Runs in a few minutes on the sandboxed CPU.
+# Fast observability gate: profiling + telemetry + pipeline +
+# observability unit tests, then one smoke-shaped bench.py run through
+# the full parent/child/--baseline machinery, asserting the ONE-JSON-line
+# stdout contract the round driver depends on, and finally profile-diff +
+# telemetry-report self-checks over two smoke bench lines.  Runs in a few
+# minutes on the sandboxed CPU.
 smoke:
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 		$(PY) -m pytest tests/test_profiling.py tests/test_telemetry.py \
-		tests/test_telemetry_contract.py tests/test_runtime_pipeline.py -q
+		tests/test_telemetry_contract.py tests/test_runtime_pipeline.py \
+		tests/test_observability.py -q
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= MUSICAAL_BENCH_SMOKE=1 \
 		$(PY) bench.py --baseline --attempts 1 --deadline 240 \
 		| $(PY) -c "import json,sys; \
@@ -38,7 +40,12 @@ print('smoke ok:', payload['metric'], payload['value'])"
 		$(PY) -m music_analyst_tpu profile-diff \
 		"$$tmpdir/a.json" "$$tmpdir/b.json" --threshold 0.5; rc=$$?; \
 	if [ $$rc -eq 2 ]; then echo "profile-diff: unusable input"; exit 1; \
-	else echo "profile-diff self-check ok (exit $$rc)"; fi
+	else echo "profile-diff self-check ok (exit $$rc)"; fi; \
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+		$(PY) -m music_analyst_tpu telemetry-report \
+		"$$tmpdir/a.json" "$$tmpdir/b.json" || \
+		{ echo "telemetry-report self-check failed"; exit 1; }; \
+	echo "telemetry-report self-check ok"
 
 test:
 	$(PY) -m pytest tests/ -q

@@ -224,16 +224,44 @@ def measure() -> dict:
     return payload
 
 
+# Watchdog defaults inside the bench children (override/disable with
+# $MUSICAAL_WATCHDOG_S).  The measurement child allows a slow first axon
+# compile; the probe child must classify a dead tunnel BEFORE the parent
+# SIGKILLs it at PROBE_TIMEOUT_S — SIGKILL leaves no post-mortem, the
+# watchdog's flight record is the only artifact that survives.
+CHILD_WATCHDOG_S = 120.0
+PROBE_WATCHDOG_S = 20.0
+
+
 def _run_child() -> int:
+    from music_analyst_tpu.observability import (
+        install_flight_recorder,
+        resolve_watchdog_timeout,
+        start_watchdog,
+    )
+
+    install_flight_recorder()
+    start_watchdog(resolve_watchdog_timeout(default=CHILD_WATCHDOG_S))
     print(json.dumps(measure()))
     return 0
 
 
 def _probe_child() -> int:
     """Cheapest possible device touch: no compile, no data, no cache."""
-    import jax
+    from music_analyst_tpu.observability import (
+        install_flight_recorder,
+        resolve_watchdog_timeout,
+        start_watchdog,
+        watch,
+    )
 
-    print(len(jax.devices()))
+    install_flight_recorder()
+    start_watchdog(resolve_watchdog_timeout(default=PROBE_WATCHDOG_S))
+    with watch("device_probe", kind="probe"):
+        import jax
+
+        n = len(jax.devices())
+    print(n)
     return 0
 
 
@@ -321,6 +349,26 @@ def _baseline_augment(threshold: float = 0.1,
     return augment
 
 
+def _fresh_flight_record(since_wall: float) -> tuple[str | None, str | None]:
+    """(path, taxonomy) of a child-dumped flight record newer than
+    ``since_wall`` in ``$MUSICAAL_FLIGHT_RECORD_DIR``; (None, None) if no
+    record, a stale one (probe and measure children share the file name),
+    or no record dir is configured (the unit tests' fake-run parents).
+    """
+    directory = os.environ.get("MUSICAAL_FLIGHT_RECORD_DIR", "").strip()
+    if not directory:
+        return None, None
+    path = os.path.join(directory, "flight_record.json")
+    try:
+        if os.path.getmtime(path) < since_wall:
+            return None, None
+        with open(path, encoding="utf-8") as fh:
+            record = json.load(fh)
+    except (OSError, ValueError):
+        return None, None
+    return path, record.get("taxonomy")
+
+
 def _last_json_line(text: str) -> dict | None:
     for line in reversed(text.strip().splitlines()):
         line = line.strip()
@@ -372,7 +420,14 @@ def _run_parent(
     def remaining() -> float:
         return deadline_s - (clock() - start)
 
+    # Taxonomy for the terminal line: a child's flight record (written by
+    # its watchdog before the parent killed it) is ground truth; the
+    # pattern classifier over the error string is the fallback.
+    from music_analyst_tpu.observability.report import classify_error
+
     last_error = "no attempt fit inside the deadline"
+    last_error_kind: str | None = "deadline_expired"
+    flight_record: str | None = None
     attempt = 0
     probe_cap = PROBE_TIMEOUT_S
     while attempt < attempts and remaining() - SAFETY_S >= MIN_ATTEMPT_S:
@@ -383,6 +438,7 @@ def _run_parent(
         # full measurement.
         afford_probe = remaining() - SAFETY_S - MIN_ATTEMPT_S
         if afford_probe >= MIN_PROBE_S:
+            t_probe = time.time()
             status, probe_error = _probe_device(
                 run, min(probe_cap, afford_probe)
             )
@@ -393,6 +449,14 @@ def _run_parent(
                 probe_cap = PROBE_TIMEOUT_S
             else:
                 last_error = probe_error
+                path, taxonomy = _fresh_flight_record(t_probe)
+                if path:
+                    flight_record = path
+                last_error_kind = (
+                    taxonomy
+                    or ("tunnel_dead" if status == "timeout"
+                        else classify_error(probe_error))
+                )
                 probe_cap = (
                     PROBE_HUNG_TIMEOUT_S
                     if status == "timeout"
@@ -412,6 +476,7 @@ def _run_parent(
         if remaining() - SAFETY_S < MIN_ATTEMPT_S:
             break
         budget = min(ATTEMPT_CAP_S, remaining() - SAFETY_S)
+        t_attempt = time.time()
         try:
             proc = run(
                 [sys.executable, os.path.abspath(__file__), "--child"],
@@ -438,6 +503,15 @@ def _run_parent(
             last_error = (
                 " | ".join(tail[-3:]) if tail else f"rc={proc.returncode}"
             )
+        # The child's watchdog classifies its own hang (compile_hang vs
+        # stage_stall vs tunnel_dead) far better than the parent can from
+        # the outside; its record also carries the thread stacks.
+        path, taxonomy = _fresh_flight_record(t_attempt)
+        if path:
+            flight_record = path
+        last_error_kind = taxonomy or classify_error(
+            last_error, None if proc is None else proc.returncode
+        )
         attempt += 1
         # Backoff (a killed mid-compile child wedges the lease and wants a
         # gap) — but only what the remaining budget can afford: sleeping
@@ -448,14 +522,27 @@ def _run_parent(
             sleep(min(gap, affordable))
     # Terminal failure: still exactly one parseable JSON line, emitted
     # BEFORE the deadline (the loop guard guarantees ≥ SAFETY_S remains).
+    if flight_record is None and os.environ.get("MUSICAAL_FLIGHT_RECORD_DIR"):
+        # No child left a record (e.g. nothing but the deadline expired):
+        # the parent dumps its own, so every failed bench has an artifact.
+        from music_analyst_tpu.observability import get_flight_recorder
+
+        flight_record = get_flight_recorder().dump(
+            reason="bench_deadline",
+            taxonomy=last_error_kind,
+            detail=last_error[-500:],
+        )
     payload = {
         "metric": METRIC,
         "value": 0.0,
         "unit": "songs/sec (benchmark failed; see error)",
         "vs_baseline": 0.0,
         "error": last_error[-800:],
+        "error_kind": last_error_kind,
         "gave_up_after_s": round(clock() - start, 1),
     }
+    if flight_record:
+        payload["flight_record"] = flight_record
     if augment is not None:
         payload = augment(payload)
     print(json.dumps(payload))
@@ -506,6 +593,15 @@ def main(argv: list[str] | None = None) -> int:
         return _probe_child()
     if args.child:
         return _run_child()
+    # One shared flight-record dir for the whole bench: children inherit it
+    # via the environment and dump there when their watchdog trips or they
+    # crash; the parent reads it back to classify the terminal error line.
+    if not os.environ.get("MUSICAAL_FLIGHT_RECORD_DIR"):
+        import tempfile
+
+        os.environ["MUSICAAL_FLIGHT_RECORD_DIR"] = tempfile.mkdtemp(
+            prefix="musicaal_flight_"
+        )
     augment = (
         _baseline_augment(args.baseline_threshold) if args.baseline else None
     )

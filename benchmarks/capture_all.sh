@@ -39,6 +39,24 @@ except ValueError:
 print(int(v) if math.isfinite(v) and v > 0 else 480)')
 suite_timeout=${MUSICAAL_CAPTURE_TIMEOUT_S:-$(( bench_deadline + 420 ))}
 
+# Cheap device health probe BEFORE any suite: its verdict is stamped into
+# every <suite>.error.json written this session, so a dead tunnel (every
+# suite fails identically) is distinguishable from a suite bug (probe ok,
+# one suite fails) without re-reading N stderr tails.
+echo "=== device health probe ===" >&2
+probe_err=$(mktemp)
+if timeout 60 python bench.py --probe >/dev/null 2>"$probe_err"; then
+    device_health=ok
+    device_health_error=""
+else
+    device_health=dead
+    device_health_error=$(tail -c 2000 "$probe_err")
+fi
+rm -f "$probe_err"
+echo "    device_health=$device_health" >&2
+export MUSICAAL_CAPTURE_DEVICE_HEALTH="$device_health"
+export MUSICAAL_CAPTURE_DEVICE_HEALTH_ERROR="$device_health_error"
+
 for suite in $suites; do
     echo "=== $suite ===" >&2
     tmp=$(mktemp)
@@ -60,18 +78,32 @@ for suite in $suites; do
         # <suite>.error.json — the last good <suite>.json stays in place.
         python - "$suite" "$out_dir" /tmp/capture_${suite}.err <<'PYEOF'
 import json, os, sys
+# observability/report.py is jax-free by contract: importable even when
+# the suite just died on a dead backend.
+from music_analyst_tpu.observability.report import classify_error
 suite, out_dir, err_path = sys.argv[1:4]
 try:
     with open(err_path, encoding="utf-8", errors="replace") as fh:
         tail = " | ".join(fh.read().strip().splitlines()[-3:])
 except OSError:
     tail = "suite timed out or crashed before writing stderr"
+health = os.environ.get("MUSICAAL_CAPTURE_DEVICE_HEALTH", "unknown")
+if health == "dead":
+    # The pre-session probe already failed: the suite never had a live
+    # device, whatever its own stderr says.
+    kind = classify_error(
+        os.environ.get("MUSICAAL_CAPTURE_DEVICE_HEALTH_ERROR") or tail
+    ) or "tunnel_dead"
+else:
+    kind = classify_error(tail) or "unknown_error"
 stub = {
     "metric": f"suite:{suite}",
     "value": 0.0,
     "unit": "capture failed; see error",
     "vs_baseline": 0.0,
     "error": (tail or "capture failed with empty stderr")[-800:],
+    "error_kind": kind,
+    "device_health": health,
     "gave_up_after_s": 0.0,
 }
 path = os.path.join(out_dir, f"{suite}.error.json")

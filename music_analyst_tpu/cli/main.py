@@ -9,9 +9,12 @@ with the same flags plus TPU-era additions (``--device``, ``--batch-size``):
 * ``split``     ≙ ``scripts/split_csv_columns.py``
 
 TPU-era subcommands with no reference analogue: ``sweep`` (scaling
-sweeps), ``validate`` (weight certification), and ``profile-diff`` (the
-perf-regression gate over run manifests / bench lines).  Every run-scoped
-subcommand takes ``--profile-dir`` to capture device + span traces.
+sweeps), ``validate`` (weight certification), ``profile-diff`` (the
+perf-regression gate over run manifests / bench lines), and
+``telemetry-report`` (cross-run analytics over telemetry dirs + bench
+captures).  Every run-scoped subcommand takes ``--profile-dir`` to
+capture device + span traces and ``--watchdog-timeout`` to arm the
+hang-classifying heartbeat watchdog (observability/).
 """
 
 from __future__ import annotations
@@ -60,6 +63,11 @@ def _add_telemetry_flags(p: argparse.ArgumentParser) -> None:
                    help="Capture a device profiler trace + span-level "
                         "Chrome trace (trace_spans.json) into this dir "
                         "(profiling/trace.py)")
+    p.add_argument("--watchdog-timeout", default=None,
+                   help="Heartbeat watchdog timeout in seconds: a stage/"
+                        "compile/device scope silent this long dumps a "
+                        "classified flight_record.json (default "
+                        "$MUSICAAL_WATCHDOG_S, 0 = disabled)")
 
 
 def _add_analyze(sub: argparse._SubParsersAction) -> None:
@@ -202,6 +210,22 @@ def _add_profile_diff(sub: argparse._SubParsersAction) -> None:
                         "for manifests (default 0.25)")
 
 
+def _add_telemetry_report(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "telemetry-report",
+        help="cross-run analytics: aggregate telemetry dirs / BENCH_r*.json "
+             "captures / bench lines into a run-over-run report "
+             "(observability/report.py); exit 1 when the newest run failed",
+    )
+    p.add_argument("sources", nargs="+",
+                   help="Run sources, oldest first: telemetry run dirs, "
+                        "BENCH_r*.json driver captures, bench-line JSON "
+                        "files, or flight_record.json files")
+    p.add_argument("--json", action="store_true",
+                   help="Emit the aggregated report as one JSON object "
+                        "instead of text")
+
+
 def _add_sweep(sub: argparse._SubParsersAction) -> None:
     p = sub.add_parser(
         "sweep",
@@ -228,6 +252,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_sweep(sub)
     _add_validate(sub)
     _add_profile_diff(sub)
+    _add_telemetry_report(sub)
     args = parser.parse_args(argv)
 
     if args.command == "profile-diff":
@@ -240,11 +265,35 @@ def main(argv: Optional[List[str]] = None) -> int:
             wall_threshold=args.wall_threshold,
         )
 
+    if args.command == "telemetry-report":
+        # Pure host-side aggregation — must work against a dead tunnel,
+        # so like profile-diff it never configures telemetry or jax.
+        from music_analyst_tpu.observability.report import (
+            run_telemetry_report,
+        )
+
+        return run_telemetry_report(args.sources, json_output=args.json)
+
     from music_analyst_tpu.telemetry import configure
 
     configure(
         enabled=not args.no_telemetry, directory=args.telemetry_dir
     )
+
+    from music_analyst_tpu.observability import (
+        install_flight_recorder,
+        resolve_watchdog_timeout,
+        start_watchdog,
+    )
+
+    # Every run-scoped subcommand flies with the recorder installed: an
+    # unhandled exception or SIGTERM leaves flight_record.json behind.
+    # The watchdog is opt-in (--watchdog-timeout / $MUSICAAL_WATCHDOG_S).
+    install_flight_recorder()
+    try:
+        start_watchdog(resolve_watchdog_timeout(args.watchdog_timeout))
+    except ValueError as exc:
+        parser.error(str(exc))
 
     from music_analyst_tpu.profiling.trace import profile_run
 

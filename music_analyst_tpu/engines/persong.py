@@ -29,6 +29,7 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 from music_analyst_tpu.data.csv_io import sniff_delimiter
 from music_analyst_tpu.data.tokenizer import tokenize_latin1
+from music_analyst_tpu.observability import watchdog
 from music_analyst_tpu.runtime import PrefetchPipeline, Stage
 from music_analyst_tpu.telemetry import get_telemetry
 
@@ -189,6 +190,10 @@ def _persong_stream(
 
             def fold(chunk_result: List[_SongCounts]) -> None:
                 nonlocal total_rows
+                # Per-chunk heartbeat: a healthy fold beats often; a
+                # wedged writer or reader goes silent and the enclosing
+                # watch classifies it as host_stall.
+                watchdog.beat("persong.fold")
                 for song_counts in chunk_result:
                     total_rows += 1
                     if song_counts is None:
@@ -219,7 +224,7 @@ def _persong_stream(
             # the reader's file handle goes away.
             with contextlib.closing(
                 pipe.run(_iter_chunks(reader, _CHUNK_ROWS))
-            ) as results:
+            ) as results, watchdog.watch("persong.fold", kind="host"):
                 for chunk_result in results:
                     fold(chunk_result)
 

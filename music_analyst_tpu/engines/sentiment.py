@@ -25,6 +25,7 @@ import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from music_analyst_tpu.data.csv_io import iter_songs
+from music_analyst_tpu.observability import watchdog
 from music_analyst_tpu.runtime import (
     PrefetchPipeline,
     Stage,
@@ -369,7 +370,11 @@ def _run_sentiment_impl(
 
     def finish(rows_batch, handle, t_submit, measured) -> None:
         with tel.span("compute", rows=len(rows_batch)):
-            labels = clf.collect(handle)
+            # collect() is the device-blocking edge — over the loopback
+            # tunnel it can hang without erroring; let the watchdog
+            # classify that as device_stall instead of silence.
+            with watchdog.watch("sentiment.collect", kind="device"):
+                labels = clf.collect(handle)
         elapsed = time.perf_counter() - t_submit
         # Submit→collect wall time per batch — the batched analogue of the
         # reference's per-song HTTP latency column.

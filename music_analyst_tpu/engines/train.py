@@ -198,13 +198,17 @@ def _with_step_telemetry(step):
     """
     import functools
 
+    from music_analyst_tpu.observability import watchdog
     from music_analyst_tpu.telemetry import get_telemetry
 
     @functools.wraps(step)
     def timed_step(state, token_ids, lengths, segment_ids=None):
         tel = get_telemetry()
         with tel.span("train_step"):
-            out = step(state, token_ids, lengths, segment_ids)
+            # A dispatch that never returns (tunnel hang mid-step) is a
+            # device stall; the watchdog names it instead of a dead bench.
+            with watchdog.watch("train.step", kind="device"):
+                out = step(state, token_ids, lengths, segment_ids)
         tel.count("train_steps")
         return out
 

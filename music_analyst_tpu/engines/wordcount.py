@@ -31,6 +31,7 @@ from music_analyst_tpu.data.splitter import (
     split_dataset_columns,
 )
 from music_analyst_tpu.metrics.perf import TimeStats, write_performance_metrics
+from music_analyst_tpu.observability import watchdog
 from music_analyst_tpu.metrics.timer import StageTimer
 from music_analyst_tpu.ops.histogram import (
     sharded_histogram,
@@ -132,7 +133,9 @@ def _run_analysis_instrumented(
         },
         count_mode=count_mode,
     )
-    with timer.stage("device_compute"):
+    with timer.stage("device_compute"), watchdog.watch(
+        "wordcount.device_compute", kind="device"
+    ):
         # np.asarray is the synchronization point: block_until_ready is not
         # reliable on every PJRT plugin, and the engine needs the host
         # copies anyway.  "host-shard" (default, and the faster layout on

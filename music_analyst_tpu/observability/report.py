@@ -1,0 +1,352 @@
+"""Cross-run telemetry analytics: ``telemetry-report``.
+
+PR 1 gave each run a telemetry dir, PR 2 a perf gate between *two* runs;
+this reads *across* runs: bench driver captures (``BENCH_r*.json``), raw
+bench JSON lines, and telemetry run dirs (``run_manifest.json`` +
+``telemetry.jsonl`` + ``flight_record.json``) aggregate into one
+run-over-run report — metric trajectory, error-taxonomy histogram,
+stall/queue-depth breakdown, recompile counts.
+
+Two classification sources, newest-wins:
+
+* explicit ``error_kind`` (bench lines written after this PR carry the
+  watchdog's verdict; flight records carry ``taxonomy``), else
+* :func:`classify_error`, a pattern table over legacy error strings and
+  process tails — this is what turns the committed ``BENCH_r05.json``
+  ("device probe timed out after 40s (tunnel dead?)") into a structured
+  ``tunnel_dead`` without rewriting history.
+
+Exit codes follow ``profiling/diff.py``: 0 = newest run healthy, 1 = the
+newest run failed (the report names its taxonomy), 2 = no usable input.
+Jax-free by design — it must run against a dead tunnel.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+# Ordered pattern table: first match wins.  Tunnel patterns outrank the
+# compile ones because a dead-tunnel traceback contains "setup/compile
+# error" (see BENCH_r01.json) and must not read as a compile hang.
+_ERROR_PATTERNS = (
+    ("tunnel_dead", (
+        "tunnel dead", "tunnel hang", "probe timed out",
+        "unable to initialize backend", "backend setup/compile error",
+        "unavailable:",
+    )),
+    ("host_oom", (
+        "memoryerror", "out of memory", "cannot allocate memory",
+        "oom-kill",
+    )),
+    ("compile_hang", (
+        "compile timed out", "compile hang", "compile stall",
+        "stuck compiling",
+    )),
+    ("stage_stall", ("stage stall", "stage_stall")),
+    ("deadline_expired", ("deadline",)),
+    ("harness_killed", ("killed by harness", "sigkill")),
+)
+
+
+def classify_error(
+    message: Optional[str], rc: Optional[int] = None
+) -> Optional[str]:
+    """Map a legacy error string (and/or exit code) to a taxonomy code.
+
+    Returns None for "no error" (empty message with a zero rc); a
+    nonempty message that matches nothing classifies as
+    ``unknown_error`` — the histogram should show *that* the run failed
+    even when it cannot say why.
+    """
+    text = (message or "").lower()
+    for kind, needles in _ERROR_PATTERNS:
+        if any(needle in text for needle in needles):
+            return kind
+    if rc == 124:  # coreutils `timeout` — the driver's outer kill
+        return "harness_killed"
+    if "timed out" in text or "timeout" in text:
+        return "attempt_timeout"
+    if text:
+        return "unknown_error"
+    if rc not in (None, 0):
+        return "unknown_error"
+    return None
+
+
+# ---------------------------------------------------------------- loading
+
+
+def _label(source: str) -> str:
+    base = os.path.basename(os.path.normpath(source))
+    return base[:-5] if base.endswith(".json") else base
+
+
+def _bench_line_record(
+    payload: Dict[str, Any], label: str, rc: Optional[int] = None
+) -> Dict[str, Any]:
+    error = payload.get("error")
+    kind = payload.get("error_kind") or classify_error(error, rc)
+    return {
+        "label": label,
+        "kind": "bench",
+        "ok": kind is None,
+        "metric": payload.get("metric"),
+        "value": payload.get("value"),
+        "unit": payload.get("unit"),
+        "error": error,
+        "error_kind": kind,
+        "flight_record": payload.get("flight_record"),
+        "telemetry": payload.get("telemetry"),
+    }
+
+
+def _capture_record(payload: Dict[str, Any], label: str) -> Dict[str, Any]:
+    """A driver capture: {"n", "cmd", "rc", "tail", "parsed"}."""
+    rc = payload.get("rc")
+    parsed = payload.get("parsed")
+    if isinstance(parsed, dict):
+        rec = _bench_line_record(parsed, label, rc)
+        rec["rc"] = rc
+        return rec
+    # No bench line survived: classify the process tail.
+    kind = classify_error(payload.get("tail"), rc) or "unknown_error"
+    return {
+        "label": label,
+        "kind": "bench",
+        "ok": False,
+        "metric": None,
+        "value": None,
+        "error": f"no bench line (rc={rc})",
+        "error_kind": kind,
+        "rc": rc,
+    }
+
+
+def _scan_jsonl(path: str) -> Dict[str, Any]:
+    """Cheap single pass over a telemetry.jsonl: event count + trips."""
+    events = 0
+    trips: List[Dict[str, Any]] = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            events += 1
+            try:
+                event = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if event.get("name") == "watchdog_trip":
+                trips.append(event.get("attrs") or {})
+    return {"events": events, "trips": trips}
+
+
+def _dir_record(directory: str, label: str) -> Optional[Dict[str, Any]]:
+    """A telemetry run dir: manifest + JSONL + optional flight record."""
+    manifest_path = os.path.join(directory, "run_manifest.json")
+    jsonl_path = os.path.join(directory, "telemetry.jsonl")
+    flight_path = os.path.join(directory, "flight_record.json")
+    rec: Dict[str, Any] = {
+        "label": label, "kind": "run_dir", "ok": True,
+        "error": None, "error_kind": None,
+    }
+    found = False
+    if os.path.exists(manifest_path):
+        found = True
+        try:
+            with open(manifest_path, "r", encoding="utf-8") as fh:
+                manifest = json.load(fh)
+        except (json.JSONDecodeError, OSError):
+            manifest = {}
+        counters = manifest.get("counters") or {}
+        compile_info = manifest.get("compile") or {}
+        rec.update(
+            engine=manifest.get("engine"),
+            wall_seconds=manifest.get("wall_seconds"),
+            compile_count=compile_info.get("count"),
+            compile_seconds=compile_info.get("seconds"),
+            recompiles=int(counters.get("profiling.recompiles", 0)),
+            pipeline=manifest.get("pipeline") or {},
+        )
+        obs = manifest.get("observability") or {}
+        trips = (obs.get("watchdog") or {}).get("trips") or []
+        if trips:
+            rec["trips"] = trips
+    if os.path.exists(jsonl_path):
+        found = True
+        scan = _scan_jsonl(jsonl_path)
+        rec["events"] = scan["events"]
+        if scan["trips"]:
+            rec.setdefault("trips", [])
+            rec["trips"] = scan["trips"]  # JSONL is ground truth
+    if os.path.exists(flight_path):
+        found = True
+        try:
+            with open(flight_path, "r", encoding="utf-8") as fh:
+                flight = json.load(fh)
+            rec["flight_record"] = flight_path
+            rec["error_kind"] = (
+                flight.get("taxonomy")
+                or classify_error(flight.get("detail"))
+                or "unknown_error"
+            )
+            rec["error"] = flight.get("detail") or flight.get("reason")
+            rec["ok"] = False
+        except (json.JSONDecodeError, OSError):
+            pass
+    if rec.get("trips") and rec.get("error_kind") is None:
+        rec["error_kind"] = rec["trips"][-1].get("taxonomy", "unknown_error")
+        rec["error"] = f"watchdog tripped on {rec['trips'][-1].get('task')}"
+        rec["ok"] = False
+    return rec if found else None
+
+
+def load_run(source: str) -> Optional[Dict[str, Any]]:
+    """Normalize one source (file or dir) into a run record, or None."""
+    label = _label(source)
+    if os.path.isdir(source):
+        return _dir_record(source, label)
+    if not os.path.exists(source):
+        return None
+    try:
+        with open(source, "r", encoding="utf-8") as fh:
+            payload = json.load(fh)
+    except (json.JSONDecodeError, OSError):
+        return None
+    if not isinstance(payload, dict):
+        return None
+    if "parsed" in payload and "rc" in payload:
+        return _capture_record(payload, label)
+    if "metric" in payload and "value" in payload:
+        return _bench_line_record(payload, label)
+    if "schema" in payload and "reason" in payload:  # bare flight record
+        return {
+            "label": label, "kind": "flight", "ok": False,
+            "error": payload.get("detail") or payload.get("reason"),
+            "error_kind": payload.get("taxonomy") or "unknown_error",
+            "flight_record": source,
+        }
+    return None
+
+
+# -------------------------------------------------------------- reporting
+
+
+def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate normalized run records (oldest→newest input order)."""
+    taxonomy: Dict[str, int] = {}
+    trajectory: List[Dict[str, Any]] = []
+    stalls: List[Dict[str, Any]] = []
+    recompiles: Dict[str, int] = {}
+    for rec in records:
+        if rec.get("error_kind"):
+            taxonomy[rec["error_kind"]] = taxonomy.get(rec["error_kind"], 0) + 1
+        if rec.get("metric") is not None:
+            trajectory.append({
+                "label": rec["label"],
+                "metric": rec["metric"],
+                "value": rec.get("value"),
+                "ok": rec["ok"],
+            })
+        if rec.get("recompiles"):
+            recompiles[rec["label"]] = rec["recompiles"]
+        for name, pipe in (rec.get("pipeline") or {}).items():
+            for stage in pipe.get("stages") or []:
+                if stage.get("stall_s") or stage.get("queue_depth_max"):
+                    stalls.append({
+                        "label": rec["label"],
+                        "pipeline": name,
+                        "stage": stage.get("stage"),
+                        "stall_s": stage.get("stall_s"),
+                        "queue_depth_max": stage.get("queue_depth_max"),
+                    })
+    newest = records[-1] if records else None
+    return {
+        "schema": 1,
+        "runs": records,
+        "n_runs": len(records),
+        "n_failed": sum(1 for r in records if not r["ok"]),
+        "metric_trajectory": trajectory,
+        "taxonomy_histogram": dict(
+            sorted(taxonomy.items(), key=lambda kv: (-kv[1], kv[0]))
+        ),
+        "stalls": stalls,
+        "recompiles": recompiles,
+        "newest": {
+            "label": newest["label"],
+            "ok": newest["ok"],
+            "error_kind": newest.get("error_kind"),
+        } if newest else None,
+    }
+
+
+def render_report(report: Dict[str, Any]) -> List[str]:
+    """The human-facing text rendering (one line list, print-ready)."""
+    lines = [
+        f"telemetry-report: {report['n_runs']} run(s), "
+        f"{report['n_failed']} failed"
+    ]
+    if report["metric_trajectory"]:
+        lines.append("metric trajectory:")
+        for point in report["metric_trajectory"]:
+            value = point["value"]
+            shown = f"{value:.1f}" if isinstance(value, (int, float)) else "-"
+            flag = "" if point["ok"] else "  [FAILED]"
+            lines.append(
+                f"  {point['label']}: {point['metric']} = {shown}{flag}"
+            )
+    if report["taxonomy_histogram"]:
+        lines.append("error taxonomy:")
+        width = max(len(k) for k in report["taxonomy_histogram"])
+        for kind, n in report["taxonomy_histogram"].items():
+            lines.append(f"  {kind.ljust(width)}  {'#' * n} ({n})")
+    if report["stalls"]:
+        lines.append("pipeline stalls (stall_s / queue_depth_max):")
+        for s in report["stalls"]:
+            lines.append(
+                f"  {s['label']} {s['pipeline']}.{s['stage']}: "
+                f"{s['stall_s']} / {s['queue_depth_max']}"
+            )
+    if report["recompiles"]:
+        lines.append("recompiles:")
+        for label, n in report["recompiles"].items():
+            lines.append(f"  {label}: {n}")
+    newest = report.get("newest")
+    if newest is not None:
+        verdict = ("ok" if newest["ok"]
+                   else f"FAILED ({newest['error_kind']})")
+        lines.append(f"newest run {newest['label']}: {verdict}")
+    return lines
+
+
+def run_telemetry_report(
+    sources: List[str], json_output: bool = False
+) -> int:
+    """CLI entry.  Exit 0 = newest healthy, 1 = newest failed, 2 = no
+    usable input — diff.py's gate semantics, so CI can chain them."""
+    import sys
+
+    records: List[Dict[str, Any]] = []
+    skipped: List[str] = []
+    for source in sources:
+        rec = load_run(source)
+        if rec is None:
+            skipped.append(source)
+        else:
+            records.append(rec)
+    for source in skipped:
+        print(f"telemetry-report: skipping unusable source: {source}",
+              file=sys.stderr)
+    if not records:
+        print("telemetry-report: no usable runs among "
+              f"{len(sources)} source(s)", file=sys.stderr)
+        return 2
+    report = build_report(records)
+    if json_output:
+        print(json.dumps(report, default=str))
+    else:
+        for line in render_report(report):
+            print(line)
+    return 0 if report["newest"]["ok"] else 1

@@ -148,9 +148,14 @@ class ProfiledFunction:
 
         tel = get_telemetry()
         try:
+            from music_analyst_tpu.observability import watchdog
+
             t0 = time.perf_counter()
-            lowered = self._jit.lower(*args, **kwargs)
-            compiled = lowered.compile()
+            # First compiles are the classic silent-hang site on the
+            # tunneled backend; a watchdog trip here reads compile_hang.
+            with watchdog.watch(f"compile:{self.name}", kind="compile"):
+                lowered = self._jit.lower(*args, **kwargs)
+                compiled = lowered.compile()
             seconds = time.perf_counter() - t0
         except Exception as exc:
             # Not AOT-eligible (or the backend refused): the plain jit

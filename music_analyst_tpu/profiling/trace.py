@@ -1,7 +1,7 @@
 """Device-time capture: profiler traces + a span-level Chrome trace.
 
-Promoted from ``metrics/tracing.py`` (now a deprecation shim).  Two
-granularities:
+Promoted from the old ``metrics/tracing.py`` (shimmed through PR 3,
+removed in PR 4).  Two granularities:
 
 * :func:`maybe_trace` / :func:`annotate` — the raw ``jax.profiler``
   capture (HLO timelines, per-op device time) for TensorBoard/Perfetto,

@@ -48,6 +48,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Sequence
 
+from music_analyst_tpu.observability import watchdog
 from music_analyst_tpu.telemetry import get_telemetry
 
 DEFAULT_PREFETCH_DEPTH = 2
@@ -228,10 +229,16 @@ class PrefetchPipeline:
                 return
 
     def _timed_fn(self, stage: Stage, item: Any):
-        """Run one stage fn; returns ``(duration_s, result | _Failure)``."""
+        """Run one stage fn; returns ``(duration_s, result | _Failure)``.
+
+        The watchdog scope around the call is what turns "the bench went
+        silent" into ``taxonomy: stage_stall`` naming the exact stage —
+        a no-op unless a watchdog is active.
+        """
         t0 = time.perf_counter()
         try:
-            result = stage.fn(item)
+            with watchdog.watch(f"{self.name}.{stage.name}", kind="stage"):
+                result = stage.fn(item)
         except BaseException as exc:
             return time.perf_counter() - t0, _Failure(exc)
         return time.perf_counter() - t0, result

@@ -137,6 +137,10 @@ class Telemetry:
         self._tls = threading.local()
         self.enabled = enabled
         self.directory: Optional[str] = None  # explicit --telemetry-dir
+        # Event taps (observability flight recorder): process-lifetime
+        # observers, deliberately OUTSIDE _reset_run_state so a recorder
+        # installed once keeps seeing events across runs/configure().
+        self._taps: List = []
         self._reset_run_state()
 
     # ---------------------------------------------------------- run state
@@ -184,6 +188,19 @@ class Telemetry:
     def sink_path(self) -> Optional[str]:
         return self._sink_path
 
+    def add_tap(self, fn) -> None:
+        """Register a process-lifetime event observer (called with every
+        emitted event dict).  Survives run resets and ``configure()`` —
+        the flight recorder's ring must keep filling across runs."""
+        with self._lock:
+            if fn not in self._taps:
+                self._taps.append(fn)
+
+    def remove_tap(self, fn) -> None:
+        with self._lock:
+            if fn in self._taps:
+                self._taps.remove(fn)
+
     def _emit(self, event: Dict[str, Any]) -> None:
         """Count the event and append it to the JSONL sink if one is open.
 
@@ -194,6 +211,15 @@ class Telemetry:
             if self._sink is not None:
                 self._sink.write(json.dumps(event, default=str) + "\n")
                 self._sink.flush()
+            taps = list(self._taps) if self._taps else None
+        if taps:
+            # Outside the lock: a tap may itself emit (re-entrancy) and
+            # must never be able to wedge the registry.
+            for tap in taps:
+                try:
+                    tap(event)
+                except Exception:
+                    pass
 
     # -------------------------------------------------------------- spans
 

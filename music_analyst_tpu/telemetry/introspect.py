@@ -168,6 +168,23 @@ def write_run_manifest(
         }
     except Exception:
         pass
+    try:
+        # Watchdog verdicts + flight-record pointer — only when there is
+        # something to say, so unwatched runs keep the original key set.
+        from music_analyst_tpu.observability.flight import get_flight_recorder
+        from music_analyst_tpu.observability.watchdog import get_watchdog
+
+        obs: Dict[str, Any] = {}
+        wd = get_watchdog()
+        if wd is not None:
+            obs["watchdog"] = wd.snapshot()
+        rec = get_flight_recorder()
+        if rec.last_dump_path:
+            obs["flight_record"] = rec.last_dump_path
+        if obs:
+            manifest["observability"] = obs
+    except Exception:
+        pass
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, "run_manifest.json")
     with open(path, "w", encoding="utf-8") as fh:

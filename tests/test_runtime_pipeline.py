@@ -381,16 +381,21 @@ def test_sentiment_raising_backend_does_not_hang(fixture_csv, tmp_path):
     assert time.perf_counter() - t0 < 10.0
 
 
-def test_tracing_shim_warns_but_stays_importable():
-    import importlib
-    import sys
+def test_tracing_shim_removed_and_unreferenced():
+    """The PR-2 ``metrics/tracing.py`` deprecation shim is gone (PR 3
+    migrated the last internal import; PR 4 deleted it) — and nothing in
+    the package source refers to it anymore."""
+    import pathlib
 
-    sys.modules.pop("music_analyst_tpu.metrics.tracing", None)
-    with pytest.warns(DeprecationWarning, match="profiling.trace"):
-        shim = importlib.import_module("music_analyst_tpu.metrics.tracing")
-    from music_analyst_tpu.profiling import trace
+    import music_analyst_tpu
 
-    assert shim.maybe_trace is trace.maybe_trace
-    assert shim.annotate is trace.annotate
-    assert shim.force_readback is trace.force_readback
-    assert shim.profile_run is trace.profile_run
+    pkg_root = pathlib.Path(music_analyst_tpu.__file__).parent
+    assert not (pkg_root / "metrics" / "tracing.py").exists()
+    with pytest.raises(ImportError):
+        import music_analyst_tpu.metrics.tracing  # noqa: F401
+    offenders = [
+        str(path)
+        for path in pkg_root.rglob("*.py")
+        if "metrics.tracing" in path.read_text(encoding="utf-8")
+    ]
+    assert not offenders, f"stale metrics.tracing imports: {offenders}"

@@ -6,16 +6,19 @@ PY ?= python
 .PHONY: smoke test native
 
 # Fast observability gate: profiling + telemetry + pipeline +
-# observability unit tests, then one smoke-shaped bench.py run through
-# the full parent/child/--baseline machinery, asserting the ONE-JSON-line
-# stdout contract the round driver depends on, and finally profile-diff +
-# telemetry-report self-checks over two smoke bench lines.  Runs in a few
-# minutes on the sandboxed CPU.
+# observability + corpus-cache/streaming unit tests, then one
+# smoke-shaped bench.py run through the full parent/child/--baseline
+# machinery, asserting the ONE-JSON-line stdout contract the round
+# driver depends on, a two-invocation warm-corpus-cache self-check
+# (second analyze of the same file must hit the cache AND write a
+# byte-identical word_counts.csv), and finally profile-diff +
+# telemetry-report self-checks over two smoke bench lines.  Runs in a
+# few minutes on the sandboxed CPU.
 smoke:
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 		$(PY) -m pytest tests/test_profiling.py tests/test_telemetry.py \
 		tests/test_telemetry_contract.py tests/test_runtime_pipeline.py \
-		tests/test_observability.py -q
+		tests/test_observability.py tests/test_corpus_cache.py -q
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= MUSICAAL_BENCH_SMOKE=1 \
 		$(PY) bench.py --baseline --attempts 1 --deadline 240 \
 		| $(PY) -c "import json,sys; \
@@ -24,6 +27,24 @@ assert len(lines)==1, f'expected ONE JSON line, got {len(lines)}'; \
 payload=json.loads(lines[0]); \
 assert 'vs_baseline_detail' in payload, 'missing --baseline detail'; \
 print('smoke ok:', payload['metric'], payload['value'])"
+	# corpus-cache warm self-check: analyze the same fixture twice with
+	# the cache pointed at a fresh dir — the second run must record a
+	# cache hit in its run manifest and write a byte-identical
+	# word_counts.csv (golden contract: the cache may never change
+	# output bytes).
+	cachetmp=$$(mktemp -d) && trap 'rm -rf "$$cachetmp"' EXIT && \
+	for run in cold warm; do \
+		env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+			MUSICAAL_CORPUS_CACHE="$$cachetmp/cache" \
+			$(PY) -m music_analyst_tpu analyze tests/fixtures/mini_songs.csv \
+			--output-dir "$$cachetmp/$$run" --no-split >/dev/null || \
+			{ echo "corpus-cache $$run run failed"; exit 1; }; \
+	done && \
+	cmp "$$cachetmp/cold/word_counts.csv" "$$cachetmp/warm/word_counts.csv" || \
+		{ echo "warm-cache word_counts.csv diverged from cold"; exit 1; }; \
+	grep -q '"hits": [1-9]' "$$cachetmp/warm/run_manifest.json" || \
+		{ echo "warm run did not hit the corpus cache"; exit 1; }; \
+	echo "corpus-cache warm self-check ok"
 	# profile-diff self-check: two smoke bench lines must both satisfy
 	# the one-line contract and feed the regression gate without an
 	# exit-2 (unusable input).  Exit 1 (regression verdict) is tolerated

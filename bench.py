@@ -588,6 +588,14 @@ def main(argv: list[str] | None = None) -> int:
         if args.list_suites:
             print("\n".join(suite_names()))
             return 0
+        # Suites run under the same parent wall clock as the headline
+        # metric: arm the shared budget so child-spawning suites (e.g.
+        # coldstart) clamp their timeouts to what actually remains.
+        from benchmarks._util import arm_deadline
+
+        arm_deadline(
+            args.deadline if args.deadline is not None else OVERALL_DEADLINE_S
+        )
         return run_suite(args.suite)
     if args.probe:
         return _probe_child()

@@ -34,6 +34,7 @@ _SUITE_MODULES = (
     "benchmarks.sentiment_int8",
     "benchmarks.bucketing",
     "benchmarks.overlap",
+    "benchmarks.streaming",
 )
 
 

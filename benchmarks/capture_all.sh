@@ -18,7 +18,7 @@ mkdir -p "$out_dir"
 # capture is the 8-virtual-device CPU-mesh sweep, and on the one-chip
 # environment a re-run would record a trivial np=1 sweep over it.  Pass
 # it explicitly from a multi-device host to refresh.
-suites=${*:-"roofline ingest flash_sweep generation coldstart joint llama_zeroshot sentiment_int8 bucketing"}
+suites=${*:-"roofline ingest flash_sweep generation coldstart joint llama_zeroshot sentiment_int8 bucketing streaming"}
 
 # Per-suite wall-clock cap: a suite wedged on a half-healthy tunnel must
 # not stall the remaining captures (the auto-capture loop runs this
@@ -66,6 +66,11 @@ for suite in $suites; do
         if grep -q '"smoke": true' "$tmp"; then
             rm -f "$tmp"
             echo "    REFUSED: smoke mode output (unset MUSICAAL_BENCH_SMOKE)" >&2
+        # The streaming capture must carry the corpus-cache hit/miss stamp
+        # (PERFORMANCE.md reads warm-ingest numbers straight from it).
+        elif [ "$suite" = "streaming" ] && ! grep -q '"corpus_cache"' "$tmp"; then
+            rm -f "$tmp"
+            echo "    REFUSED: streaming output lacks corpus_cache stats" >&2
         else
             mv "$tmp" "$out_dir/$suite.json"
             echo "    captured -> $out_dir/$suite.json" >&2

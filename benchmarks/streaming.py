@@ -1,0 +1,100 @@
+"""Chunked streaming histogram: chunk size × prefetch depth sweep.
+
+Backs the "Corpus cache & streaming" section in PERFORMANCE.md.  The
+corpus is ingested through the persistent corpus cache (cold store, then
+a warm mmap hit — the stats ride in the result), and the word histogram
+is computed with the whole-corpus device put (``sharded_histogram``) as
+the baseline, then with ``sharded_histogram_streaming`` across a grid of
+``chunk_songs`` × ``prefetch_depth``.  Every row asserts bit-identity
+with the baseline — the golden-contract property that ``word_counts.csv``
+does not depend on the chunk size.  Each configuration is warmed once and
+timed on the second run, so rows compare steady-state throughput rather
+than first-chunk compile latency.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from benchmarks import suite
+from benchmarks._util import device_info, smoke
+
+
+@suite("streaming")
+def run() -> dict:
+    from music_analyst_tpu.data import corpus_cache
+    from music_analyst_tpu.data.ingest import ingest_dataset
+    from music_analyst_tpu.data.synthetic import generate_dataset
+    from music_analyst_tpu.ops.histogram import (
+        sharded_histogram,
+        sharded_histogram_streaming,
+    )
+    from music_analyst_tpu.parallel.mesh import data_parallel_mesh
+
+    if smoke():
+        n_songs, chunk_sizes, depths = 2_000, (64, 256), (0, 2)
+    else:
+        n_songs, chunk_sizes, depths = (
+            100_000, (1_024, 4_096, 16_384), (0, 2, 4),
+        )
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "songs.csv")
+        generate_dataset(path, num_songs=n_songs, seed=11)
+        cache_dir = os.path.join(tmp, "corpus_cache")
+        ingest_dataset(path, cache_dir=cache_dir)           # cold: store
+        start = time.perf_counter()
+        corpus = ingest_dataset(path, cache_dir=cache_dir)  # warm: mmap hit
+        warm_ingest_s = time.perf_counter() - start
+        mesh = data_parallel_mesh()
+        vocab = max(1, len(corpus.word_vocab))
+
+        sharded_histogram(corpus.word_ids, vocab, mesh)  # warm compile
+        start = time.perf_counter()
+        baseline = np.asarray(
+            sharded_histogram(corpus.word_ids, vocab, mesh)
+        )
+        baseline_s = time.perf_counter() - start
+
+        rows = []
+        for chunk in chunk_sizes:
+            for depth in depths:
+                print(
+                    f"[streaming] chunk_songs={chunk} depth={depth}",
+                    file=sys.stderr,
+                )
+                sharded_histogram_streaming(     # warm this bucket's shape
+                    corpus.word_ids, corpus.word_offsets, vocab, mesh,
+                    chunk_songs=chunk, prefetch_depth=depth,
+                )
+                start = time.perf_counter()
+                counts = sharded_histogram_streaming(
+                    corpus.word_ids, corpus.word_offsets, vocab, mesh,
+                    chunk_songs=chunk, prefetch_depth=depth,
+                )
+                rows.append({
+                    "chunk_songs": chunk,
+                    "prefetch_depth": depth,
+                    "seconds": round(time.perf_counter() - start, 4),
+                    "identical": bool(np.array_equal(counts, baseline)),
+                })
+
+    return {
+        "suite": "streaming",
+        **device_info(),
+        "smoke": smoke(),
+        "corpus": {
+            "songs": corpus.song_count,
+            "tokens": corpus.token_count,
+            "vocab": vocab,
+        },
+        "warm_ingest_seconds": round(warm_ingest_s, 4),
+        "whole_corpus_put_seconds": round(baseline_s, 4),
+        "rows": rows,
+        "corpus_cache": corpus_cache.cache_stats(),
+    }

@@ -52,6 +52,40 @@ def _buckets_arg(text: str):
     return _int_list(text)
 
 
+def _chunk_songs_arg(text: str):
+    """``--chunk-songs`` value: ``auto`` (size by corpus), ``0`` (off), or
+    a positive songs-per-chunk count."""
+    if text.strip().lower() == "auto":
+        return "auto"
+    try:
+        value = int(text)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected an integer >= 0 or 'auto', got {text!r}"
+        )
+    if value < 0:
+        raise argparse.ArgumentTypeError(
+            f"expected an integer >= 0 or 'auto', got {value}"
+        )
+    return value
+
+
+def _add_corpus_cache_flags(p: argparse.ArgumentParser) -> None:
+    """Persistent-ingest-cache + streaming flags (data/corpus_cache.py,
+    ops/histogram.py streaming path), shared by analyze and sweep."""
+    p.add_argument("--corpus-cache-dir", default=None,
+                   help="Persistent corpus-cache directory (default "
+                        "$MUSICAAL_CORPUS_CACHE or ~/.cache/musicaal_corpus)")
+    p.add_argument("--no-corpus-cache", action="store_true",
+                   help="Disable the persistent corpus cache (always "
+                        "re-ingest)")
+    p.add_argument("--chunk-songs", type=_chunk_songs_arg, default=None,
+                   help="Songs per streamed device chunk for the word "
+                        "histogram: 'auto' (default — stream only on "
+                        "large corpora), 0 = whole-corpus put, or an "
+                        "explicit count (bounds host+device memory)")
+
+
 def _add_telemetry_flags(p: argparse.ArgumentParser) -> None:
     """Run-telemetry flags, shared by every subcommand (telemetry/)."""
     p.add_argument("--telemetry-dir", default=None,
@@ -109,6 +143,7 @@ def _add_analyze(sub: argparse._SubParsersAction) -> None:
                    help="Sentiment batches staged ahead of the device in "
                         "the tokenize→transfer pipeline (default 2, or "
                         "$MUSICAAL_PREFETCH_DEPTH; 0 = no overlap)")
+    _add_corpus_cache_flags(p)
     _add_telemetry_flags(p)
 
 
@@ -157,6 +192,9 @@ def _add_wordcount_per_song(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--encoding", default="utf-8-sig")
     p.add_argument("--delimiter", default=None)
     p.add_argument("--workers", type=int, default=0)
+    p.add_argument("--chunk-rows", type=int, default=512,
+                   help="Rows per tokenize pool task (streaming "
+                        "granularity; bounds in-flight memory)")
     _add_telemetry_flags(p)
 
 
@@ -236,6 +274,7 @@ def _add_sweep(sub: argparse._SubParsersAction) -> None:
                    help="Comma-separated device counts (default: 1,2,4,8 capped)")
     p.add_argument("--output-dir", default="output")
     p.add_argument("--ingest", choices=("auto", "native", "python"), default="auto")
+    _add_corpus_cache_flags(p)
     _add_telemetry_flags(p)
 
 
@@ -332,6 +371,9 @@ def _dispatch(parser: argparse.ArgumentParser,
             output_dir=args.output_dir,
             ingest_backend=args.ingest,
             quiet=False,
+            corpus_cache_dir=args.corpus_cache_dir,
+            use_corpus_cache=not args.no_corpus_cache,
+            chunk_songs=args.chunk_songs,
         )
         for run in summary["runs"]:
             print(
@@ -362,6 +404,9 @@ def _dispatch(parser: argparse.ArgumentParser,
                     write_split=not args.no_split,
                     ingest_backend=args.ingest,
                     prefetch_depth=args.prefetch_depth,
+                    corpus_cache_dir=args.corpus_cache_dir,
+                    use_corpus_cache=not args.no_corpus_cache,
+                    chunk_songs=args.chunk_songs,
                 )
             return 0
         from music_analyst_tpu.engines.wordcount import run_analysis
@@ -377,6 +422,9 @@ def _dispatch(parser: argparse.ArgumentParser,
                 write_split=not args.no_split,
                 ingest_backend=args.ingest,
                 count_mode=args.count_mode,
+                corpus_cache_dir=args.corpus_cache_dir,
+                use_corpus_cache=not args.no_corpus_cache,
+                chunk_songs=args.chunk_songs,
             )
         return 0
 
@@ -428,6 +476,7 @@ def _dispatch(parser: argparse.ArgumentParser,
             encoding=args.encoding,
             delimiter=args.delimiter,
             workers=args.workers,
+            chunk_rows=args.chunk_rows,
         )
         return 0
 

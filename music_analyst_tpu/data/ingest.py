@@ -134,12 +134,20 @@ def ingest_dataset(
     backend: str = "auto",
     num_threads: int = 0,
     capture_records: bool = False,
+    cache_dir: Optional[str] = None,
 ) -> IngestResult:
     """Ingest a dataset CSV with the requested backend.
 
     ``capture_records=True`` additionally retains every cleaned
     ``(artist, song, text)`` row in an arena (see ``IngestResult``) so the
     joint pipeline can feed sentiment from the same single parse.
+
+    ``cache_dir`` (already resolved — see
+    ``data/corpus_cache.resolve_cache_dir``) enables the persistent corpus
+    cache: a hit skips the parse entirely and maps the id arrays back
+    read-only; a miss ingests then stores.  The key includes the backend
+    actually used, so a ``python``-oracle request can never be satisfied
+    by a native-written entry.
     """
     if backend not in ("auto", "python", "native"):
         raise ValueError(f"unknown ingest backend: {backend}")
@@ -152,12 +160,26 @@ def ingest_dataset(
                 limit=limit,
                 num_threads=num_threads,
                 capture_records=capture_records,
+                cache_dir=cache_dir,
             )
         if backend == "native":
             raise RuntimeError(
                 "native ingest requested but the C++ library is unavailable "
                 f"({native.unavailable_reason()})"
             )
+    if cache_dir:
+        from music_analyst_tpu.data import corpus_cache
+
+        cached = corpus_cache.load(
+            cache_dir, path, limit, capture_records, "python"
+        )
+        if cached is not None:
+            return cached
     with open(path, "rb") as fh:
         data = fh.read()
-    return ingest_python(data, limit=limit, capture_records=capture_records)
+    result = ingest_python(data, limit=limit, capture_records=capture_records)
+    if cache_dir:
+        corpus_cache.store(
+            cache_dir, path, limit, capture_records, "python", result
+        )
+    return result

@@ -331,8 +331,15 @@ def ingest_native(
     limit: Optional[int] = None,
     num_threads: int = 0,
     capture_records: bool = False,
+    cache_dir: Optional[str] = None,
 ):
-    """Run the C++ ingest and wrap the results as an :class:`IngestResult`."""
+    """Run the C++ ingest and wrap the results as an :class:`IngestResult`.
+
+    ``cache_dir`` plugs this backend into the persistent corpus cache
+    (``data/corpus_cache.py``): a hit returns memory-mapped arrays without
+    touching the C++ parser, a miss parses then stores under the
+    ``native``-keyed entry.
+    """
     from music_analyst_tpu.data.ingest import IngestResult
     from music_analyst_tpu.data.vocab import Vocab
 
@@ -341,6 +348,14 @@ def ingest_native(
     lib = _load()
     if lib is None:
         raise RuntimeError(f"native library unavailable: {_load_error}")
+    if cache_dir:
+        from music_analyst_tpu.data import corpus_cache
+
+        cached = corpus_cache.load(
+            cache_dir, path, limit, capture_records, "native"
+        )
+        if cached is not None:
+            return cached
     tel = get_telemetry()
     try:
         file_bytes = os.path.getsize(path)
@@ -408,7 +423,7 @@ def ingest_native(
                 handle, buf, record_offsets.ctypes.data_as(ctypes.c_void_p)
             )
             records_blob = buf.raw[:n_bytes]
-        return IngestResult(
+        result = IngestResult(
             word_vocab=Vocab(word_tokens),
             word_ids=word_ids,
             word_offsets=word_offsets,
@@ -418,5 +433,10 @@ def ingest_native(
             records_blob=records_blob,
             record_offsets=record_offsets,
         )
+        if cache_dir:
+            corpus_cache.store(
+                cache_dir, path, limit, capture_records, "native", result
+            )
+        return result
     finally:
         lib.man_free(handle)

@@ -129,12 +129,16 @@ def run_per_song_wordcount(
     delimiter: Optional[str] = None,
     workers: int = 0,
     quiet: bool = False,
+    chunk_rows: int = _CHUNK_ROWS,
 ) -> Tuple[Path, Path, int]:
     """Write both artifacts; returns (global_path, per_song_path, rows).
 
     Artifact bytes match ``scripts/word_count_per_song.py`` exactly
     (``tests/test_reference_scripts_differential.py``); the engine shape
-    does not.
+    does not.  ``chunk_rows`` is this engine's streaming-granularity knob
+    (rows per pool task — the corpus cache doesn't apply here: the
+    ``csv.DictReader``/latin-1 parse is a different artifact from
+    ``IngestResult`` by design).
     """
     src = Path(csv_path)
     if not src.exists():
@@ -144,6 +148,8 @@ def run_per_song_wordcount(
     global_path = out / "word_counts_global.csv"
     per_song_path = out / "word_counts_by_song.csv"
 
+    if chunk_rows < 1:
+        raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
     n_workers = workers if workers > 0 else max(1, os.cpu_count() or 1)
     histogram = _DenseHistogram()
     total_rows = 0
@@ -152,7 +158,7 @@ def run_per_song_wordcount(
     with tel.run_scope("persong", str(out)):
         total_rows = _persong_stream(
             src, per_song_path, global_path, encoding, delimiter,
-            n_workers, histogram, tel,
+            n_workers, histogram, tel, chunk_rows,
         )
         tel.count("rows_processed", total_rows)
         tel.count("distinct_words", len(histogram.counts))
@@ -170,7 +176,7 @@ def run_per_song_wordcount(
 
 def _persong_stream(
     src, per_song_path, global_path, encoding, delimiter, n_workers,
-    histogram, tel,
+    histogram, tel, chunk_rows,
 ) -> int:
     total_rows = 0
     with tel.span("ingest", workers=n_workers), \
@@ -223,7 +229,7 @@ def _persong_stream(
             # closing(): the pipeline must be cancelled and joined before
             # the reader's file handle goes away.
             with contextlib.closing(
-                pipe.run(_iter_chunks(reader, _CHUNK_ROWS))
+                pipe.run(_iter_chunks(reader, chunk_rows))
             ) as results, watchdog.watch("persong.fold", kind="host"):
                 for chunk_result in results:
                     fold(chunk_result)

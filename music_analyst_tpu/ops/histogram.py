@@ -29,7 +29,7 @@ from typing import List, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from music_analyst_tpu.profiling.collectives import record_collective
 from music_analyst_tpu.profiling.compile import profiled_jit
@@ -225,6 +225,141 @@ def sharded_histogram_hostlocal(
     """:func:`sharded_histogram_hostlocal_timed` without the timings."""
     counts, _ = sharded_histogram_hostlocal_timed(ids, vocab_size, mesh, axis)
     return counts
+
+
+# --- chunked streaming device path ----------------------------------------
+#
+# ``sharded_histogram`` device-puts the whole id stream at once: simple,
+# but peak host+device memory is O(corpus).  The streaming path below
+# instead walks fixed-size song-aligned chunks through the shared
+# ``runtime/prefetch.py`` pipeline — pad (host) → H2D → accumulate into a
+# per-chip dense histogram — and pays the single ``psum`` only once at the
+# end.  Chunk lengths are power-of-two bucketed, so every chunk reuses ONE
+# compiled accumulate program, and the H2D of chunk k+1 overlaps the
+# scatter-add of chunk k.  Peak memory is O(chunk · depth), independent of
+# corpus size — the property the million-song north star needs.
+
+_AUTO_STREAM_MIN_TOKENS = 1 << 22   # below this, chunking is pure overhead
+_AUTO_CHUNK_TARGET_TOKENS = 1 << 21  # ~8 MiB of int32 ids per chunk
+_STREAM_CHUNK_FLOOR = 1 << 12
+
+
+def resolve_chunk_songs(
+    chunk_songs, song_count: int, token_count: int
+) -> int:
+    """Resolve a ``--chunk-songs`` value to songs per chunk (0 = off).
+
+    Explicit ``0`` disables streaming; an explicit positive value is
+    clamped to the corpus.  ``None``/``"auto"`` streams only when the
+    corpus is big enough for chunking to pay (small corpora keep the
+    single-put paths and their per-shard timing semantics), sizing chunks
+    so each carries ~``_AUTO_CHUNK_TARGET_TOKENS`` ids.
+    """
+    if chunk_songs is not None and chunk_songs != "auto":
+        n = int(chunk_songs)
+        if n < 0:
+            raise ValueError(f"chunk-songs must be >= 0, got {n}")
+        return 0 if n == 0 else min(n, max(1, song_count))
+    if token_count < _AUTO_STREAM_MIN_TOKENS or song_count <= 1:
+        return 0
+    avg_tokens = max(1.0, token_count / song_count)
+    return max(1, min(song_count, int(_AUTO_CHUNK_TARGET_TOKENS / avg_tokens)))
+
+
+@lru_cache(maxsize=None)
+def _stream_accum(mesh: Mesh, axis: str, padded_vocab: int):
+    """One streaming step: add a chunk's per-shard histogram into the
+    running per-chip accumulator.  No collective here — chips stay
+    independent until the final ``_psum_rows`` merge."""
+
+    def local(hist, ids):
+        return hist + _token_histogram(ids, padded_vocab)[None, :]
+
+    return profiled_jit(
+        shard_map(
+            local, mesh=mesh,
+            in_specs=(P(axis, None), P(axis)), out_specs=P(axis, None),
+        ),
+        name="stream_accum_histogram",
+    )
+
+
+def sharded_histogram_streaming(
+    ids: np.ndarray,
+    offsets: np.ndarray,
+    vocab_size: int,
+    mesh: Mesh,
+    axis: str = "dp",
+    chunk_songs: int = 0,
+    prefetch_depth=None,
+) -> np.ndarray:
+    """Global histogram via bounded chunks overlapped with H2D transfer.
+
+    ``offsets`` (int64 ``[songs+1]``, from ``IngestResult``) keeps chunks
+    song-aligned, so ``--chunk-songs`` means what it says.  Identical
+    counts to :func:`sharded_histogram` at every chunk size — padding ids
+    are ``PAD_ID`` and the scatter-add drops them.
+    """
+    from music_analyst_tpu.runtime.prefetch import (
+        PrefetchPipeline, Stage, resolve_prefetch_depth,
+    )
+    from music_analyst_tpu.telemetry import get_telemetry
+
+    ids = np.asarray(ids) if ids.dtype == np.int32 else np.asarray(
+        ids, dtype=np.int32
+    )
+    offsets = np.asarray(offsets, dtype=np.int64)
+    song_count = offsets.shape[0] - 1
+    if chunk_songs <= 0:
+        raise ValueError("sharded_histogram_streaming needs chunk_songs > 0")
+    if song_count <= 0 or ids.shape[0] == 0:
+        return np.zeros((vocab_size,), dtype=np.int32)
+    shards = mesh.shape[axis]
+    padded_vocab = _bucket(vocab_size, 1 << 10)
+    bounds = list(range(0, song_count, chunk_songs)) + [song_count]
+    token_bounds = [int(offsets[b]) for b in bounds]
+    max_chunk_tokens = max(
+        e - s for s, e in zip(token_bounds, token_bounds[1:])
+    )
+    # One compiled program for every chunk: pow2-bucket the chunk length,
+    # then round up so it splits evenly over the shards.
+    bucket_len = _bucket(max(1, max_chunk_tokens), _STREAM_CHUNK_FLOOR)
+    bucket_len = -(-bucket_len // shards) * shards
+    chunk_sharding = NamedSharding(mesh, P(axis))
+    hist_sharding = NamedSharding(mesh, P(axis, None))
+    accum = _stream_accum(mesh, axis, padded_vocab)
+
+    def _pad(span):
+        start, end = span
+        chunk = np.full((bucket_len,), PAD_ID, dtype=np.int32)
+        chunk[: end - start] = ids[start:end]
+        return chunk
+
+    def _h2d(chunk):
+        return jax.device_put(chunk, chunk_sharding)
+
+    hist = jax.device_put(
+        np.zeros((shards, padded_vocab), dtype=np.int32), hist_sharding
+    )
+    n_chunks = len(token_bounds) - 1
+    depth = resolve_prefetch_depth(prefetch_depth)
+    pipe = PrefetchPipeline(
+        stages=[Stage("chunk_pad", _pad), Stage("h2d", _h2d)],
+        depth=depth,
+        name="stream_histogram",
+        sink_name="accumulate",
+    )
+    for dev_chunk in pipe.run(zip(token_bounds, token_bounds[1:])):
+        hist = accum(hist, dev_chunk)
+    tel = get_telemetry()
+    tel.count("histogram.stream_chunks", n_chunks)
+    tel.count("histogram.stream_h2d_bytes", n_chunks * bucket_len * 4)
+    record_collective(
+        "histogram.stream_merge", "psum",
+        payload_bytes=padded_vocab * 4, n_devices=shards, axis=axis,
+    )
+    # np.asarray IS the sync point (axon tunnel gotcha — see engine note).
+    return np.asarray(_psum_rows(mesh, axis)(hist))[:vocab_size]
 
 
 def sharded_total(values: np.ndarray, mesh: Mesh, axis: str = "dp") -> int:

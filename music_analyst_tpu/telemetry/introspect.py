@@ -157,6 +157,17 @@ def write_run_manifest(
         "telemetry_log": tel.sink_path,
     }
     try:
+        # Persistent-corpus-cache hit/miss/bytes-saved — process-lifetime,
+        # like the XLA cache stats; only present once the cache has been
+        # consulted, so cache-free runs keep the original key set.
+        from music_analyst_tpu.data.corpus_cache import cache_stats
+
+        corpus_stats = cache_stats()
+        if any(corpus_stats.values()):
+            manifest["corpus_cache"] = corpus_stats
+    except Exception:
+        pass
+    try:
         # Process-lifetime compile records (memoized engine callables
         # outlive a single run) — guarded so a jax-free manifest path or
         # a partial install never blocks the write.

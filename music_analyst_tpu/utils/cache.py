@@ -34,5 +34,13 @@ def enable_persistent_compilation_cache(path: str | None = None) -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
         _enabled = True
     except Exception:
-        # Cache is an optimization only; never fail a run over it.
-        _enabled = True
+        # Cache is an optimization only; never fail a run over it.  But
+        # leave _enabled False: a transient failure (unwritable dir, full
+        # disk) must stay retryable on the next call, not silently pin
+        # the process to cold compiles — and the failure is observable.
+        try:
+            from music_analyst_tpu.telemetry import get_telemetry
+
+            get_telemetry().count("xla_cache.enable_failed")
+        except Exception:
+            pass

@@ -15,6 +15,14 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # sitecustomize never registers the TPU plugin, so a wedged/dead tunnel
 # cannot hang the CPU-only test suite.
 os.environ["PALLAS_AXON_POOL_IPS"] = ""
+# Hermetic corpus cache: engine runs cache ingests by default, and the
+# default directory is under ~/.cache — point it at a per-session tmpdir
+# so tests never read (or pollute) state from earlier runs.
+import tempfile
+
+os.environ["MUSICAAL_CORPUS_CACHE"] = tempfile.mkdtemp(
+    prefix="musicaal-test-corpus-cache-"
+)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

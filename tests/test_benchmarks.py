@@ -21,7 +21,7 @@ def test_every_advertised_module_registers(monkeypatch):
     for expected in (
         "roofline", "flash_sweep", "generation", "coldstart", "ingest",
         "scaling", "joint", "llama_zeroshot", "sentiment_int8", "bucketing",
-        "overlap",
+        "overlap", "streaming",
     ):
         assert expected in names
 
@@ -29,7 +29,8 @@ def test_every_advertised_module_registers(monkeypatch):
 @pytest.mark.parametrize(
     "name",
     ["roofline", "flash_sweep", "generation", "ingest", "joint",
-     "llama_zeroshot", "sentiment_int8", "bucketing", "overlap"],
+     "llama_zeroshot", "sentiment_int8", "bucketing", "overlap",
+     "streaming"],
 )
 def test_suite_runs_smoke(name, monkeypatch):
     monkeypatch.setenv("MUSICAAL_BENCH_SMOKE", "1")

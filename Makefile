@@ -18,7 +18,8 @@ smoke:
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 		$(PY) -m pytest tests/test_profiling.py tests/test_telemetry.py \
 		tests/test_telemetry_contract.py tests/test_runtime_pipeline.py \
-		tests/test_observability.py tests/test_corpus_cache.py -q
+		tests/test_observability.py tests/test_corpus_cache.py \
+		tests/test_wq_store.py -q
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= MUSICAAL_BENCH_SMOKE=1 \
 		$(PY) bench.py --baseline --attempts 1 --deadline 240 \
 		| $(PY) -c "import json,sys; \

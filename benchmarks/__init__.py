@@ -35,6 +35,7 @@ _SUITE_MODULES = (
     "benchmarks.bucketing",
     "benchmarks.overlap",
     "benchmarks.streaming",
+    "benchmarks.wq_store",
 )
 
 

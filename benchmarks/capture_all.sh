@@ -8,6 +8,12 @@
 # is captured last (it is also what the round driver records).
 #
 # Usage: sh benchmarks/capture_all.sh [suite ...]   (default: all)
+#
+# Resumable: a suite whose results/<suite>.json is younger than
+# $MUSICAAL_CAPTURE_FRESH_S (default 24 h) is skipped, so a capture
+# session killed halfway (tunnel drop, lease loss) re-runs only what it
+# is missing.  Error stubs (<suite>.error.json) never count as fresh.
+# MUSICAAL_CAPTURE_FORCE=1 re-captures everything.
 
 set -u
 cd "$(dirname "$0")/.."
@@ -18,7 +24,24 @@ mkdir -p "$out_dir"
 # capture is the 8-virtual-device CPU-mesh sweep, and on the one-chip
 # environment a re-run would record a trivial np=1 sweep over it.  Pass
 # it explicitly from a multi-device host to refresh.
-suites=${*:-"roofline ingest flash_sweep generation coldstart joint llama_zeroshot sentiment_int8 bucketing streaming"}
+suites=${*:-"roofline ingest flash_sweep generation coldstart joint llama_zeroshot sentiment_int8 bucketing streaming wq_store"}
+
+# Freshness window for the resume check (seconds).
+fresh_s=${MUSICAAL_CAPTURE_FRESH_S:-86400}
+
+# 0 = fresh non-error capture exists (skip the suite).
+has_fresh_capture() {
+    [ "${MUSICAAL_CAPTURE_FORCE:-0}" != "0" ] && return 1
+    python - "$1" "$fresh_s" <<'PYEOF'
+import os, sys, time
+path, fresh = sys.argv[1], float(sys.argv[2])
+try:
+    age = time.time() - os.path.getmtime(path)
+except OSError:
+    sys.exit(1)
+sys.exit(0 if age < fresh else 1)
+PYEOF
+}
 
 # Per-suite wall-clock cap: a suite wedged on a half-healthy tunnel must
 # not stall the remaining captures (the auto-capture loop runs this
@@ -43,15 +66,25 @@ suite_timeout=${MUSICAAL_CAPTURE_TIMEOUT_S:-$(( bench_deadline + 420 ))}
 # every <suite>.error.json written this session, so a dead tunnel (every
 # suite fails identically) is distinguishable from a suite bug (probe ok,
 # one suite fails) without re-reading N stderr tails.
+# Retried with backoff: the loopback tunnel recovers on its own after
+# transient drops, and a single failed probe would stamp every stub this
+# session "tunnel_dead" when waiting 90 s would have found a live device.
 echo "=== device health probe ===" >&2
 probe_err=$(mktemp)
-if timeout 60 python bench.py --probe >/dev/null 2>"$probe_err"; then
-    device_health=ok
-    device_health_error=""
-else
-    device_health=dead
+device_health=dead
+device_health_error=""
+for probe_delay in 0 30 60; do
+    [ "$probe_delay" -gt 0 ] && {
+        echo "    probe failed; retrying in ${probe_delay}s" >&2
+        sleep "$probe_delay"
+    }
+    if timeout 60 python bench.py --probe >/dev/null 2>"$probe_err"; then
+        device_health=ok
+        device_health_error=""
+        break
+    fi
     device_health_error=$(tail -c 2000 "$probe_err")
-fi
+done
 rm -f "$probe_err"
 echo "    device_health=$device_health" >&2
 export MUSICAAL_CAPTURE_DEVICE_HEALTH="$device_health"
@@ -59,6 +92,11 @@ export MUSICAAL_CAPTURE_DEVICE_HEALTH_ERROR="$device_health_error"
 
 for suite in $suites; do
     echo "=== $suite ===" >&2
+    if has_fresh_capture "$out_dir/$suite.json"; then
+        echo "    SKIPPED: fresh capture < ${fresh_s}s old" \
+             "(MUSICAAL_CAPTURE_FORCE=1 to re-run)" >&2
+        continue
+    fi
     tmp=$(mktemp)
     if timeout "$suite_timeout" \
         python bench.py --suite="$suite" >"$tmp" 2>/tmp/capture_${suite}.err; then

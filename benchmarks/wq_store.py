@@ -1,0 +1,202 @@
+"""Weight-only quantized parameter store: load, cache, and run costs.
+
+Three questions PERFORMANCE.md's "Weight-only quantization" section
+answers from this suite:
+
+1. **Load** — cold (torch read + host quantize + H2D) vs warm (the
+   content-addressed ``engines/wq_cache.py`` entry, mmap'd codes straight
+   to H2D) for the same checkpoint, plus the streaming loader's peak host
+   staging (the O(one layer) bound).
+2. **Run** — songs/s of the weight-quantized classifier vs the bf16
+   baseline at the same shapes, and the label agreement between the two
+   (the accuracy cost being bought).
+3. **Fit** — lowering-level byte accounting of the FULL 8B decoder tree
+   under int8/int4 (``jax.eval_shape`` — no bytes materialize), against
+   the 16 GB single-chip HBM budget the tentpole targets.
+
+Smoke mode shrinks to the tiny encoder config; full mode uses the
+real DistilBERT architecture (the largest family the CPU mesh can
+actually run) — the 8B fit numbers are abstract either way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import tempfile
+import time
+
+from benchmarks import suite
+from benchmarks._util import device_info, smoke, timed
+
+
+def _fabricate_checkpoint(cfg, path: str) -> None:
+    """A random torch state_dict with the exact HF DistilBERT key schema
+    (what the streaming loader parses); values are irrelevant to timing."""
+    import torch
+
+    g = torch.Generator().manual_seed(0)
+
+    def r(*shape):
+        return torch.randn(*shape, generator=g) * 0.05
+
+    sd = {
+        "distilbert.embeddings.word_embeddings.weight":
+            r(cfg.vocab_size, cfg.dim),
+        "distilbert.embeddings.position_embeddings.weight":
+            r(cfg.max_positions, cfg.dim),
+        "distilbert.embeddings.LayerNorm.weight": 1 + r(cfg.dim),
+        "distilbert.embeddings.LayerNorm.bias": r(cfg.dim),
+    }
+    for i in range(cfg.n_layers):
+        p = f"distilbert.transformer.layer.{i}."
+        for lin in ("q_lin", "k_lin", "v_lin", "out_lin"):
+            sd[p + f"attention.{lin}.weight"] = r(cfg.dim, cfg.dim)
+            sd[p + f"attention.{lin}.bias"] = r(cfg.dim)
+        sd[p + "sa_layer_norm.weight"] = 1 + r(cfg.dim)
+        sd[p + "sa_layer_norm.bias"] = r(cfg.dim)
+        sd[p + "ffn.lin1.weight"] = r(cfg.hidden_dim, cfg.dim)
+        sd[p + "ffn.lin1.bias"] = r(cfg.hidden_dim)
+        sd[p + "ffn.lin2.weight"] = r(cfg.dim, cfg.hidden_dim)
+        sd[p + "ffn.lin2.bias"] = r(cfg.dim)
+        sd[p + "output_layer_norm.weight"] = 1 + r(cfg.dim)
+        sd[p + "output_layer_norm.bias"] = r(cfg.dim)
+    sd["pre_classifier.weight"] = r(cfg.dim, cfg.dim)
+    sd["pre_classifier.bias"] = r(cfg.dim)
+    sd["classifier.weight"] = r(cfg.n_classes, cfg.dim)
+    sd["classifier.bias"] = r(cfg.n_classes)
+    torch.save(sd, path)
+
+
+def _fit_8b() -> dict:
+    """Abstract (eval_shape) byte accounting of the full 8B decoder."""
+    import jax
+    import jax.numpy as jnp
+
+    from music_analyst_tpu.models.layers import causal_mask
+    from music_analyst_tpu.models.llama import LlamaConfig, LlamaModel
+    from music_analyst_tpu.ops.quant import param_tree_bytes, quantize_tree
+
+    cfg = LlamaConfig()  # the real 8B architecture
+    model = LlamaModel(cfg)
+    params_shape = jax.eval_shape(
+        lambda k: model.init(
+            k,
+            jnp.zeros((1, 8), jnp.int32),
+            jnp.zeros((1, 8), jnp.int32),
+            causal_mask(8, 8, 0),
+        )["params"],
+        jax.random.key(0),
+    )
+    hbm = 16 * (1 << 30)
+    out = {}
+    # bf16 reference: the float tree at inference dtype.
+    n_params = sum(
+        int(jnp.prod(jnp.asarray(leaf.shape)))
+        for leaf in jax.tree_util.tree_leaves(params_shape)
+    )
+    out["bf16"] = {
+        "stored_gib": round(n_params * 2 / (1 << 30), 2),
+        "fits_16gib_hbm": n_params * 2 < hbm,
+    }
+    for scheme in ("int8", "int4"):
+        qtree = jax.eval_shape(
+            lambda t: quantize_tree(t, scheme), params_shape
+        )
+        acc = param_tree_bytes(qtree)
+        out[scheme] = {
+            "stored_gib": round(acc["stored_bytes"] / (1 << 30), 2),
+            "quantized_gib": round(acc["quantized_bytes"] / (1 << 30), 2),
+            "dequant_transient_gib": round(
+                acc["dequant_transient_bytes"] / (1 << 30), 2
+            ),
+            "n_quantized_leaves": acc["n_quantized_leaves"],
+            "fits_16gib_hbm": (
+                acc["stored_bytes"] + acc["dequant_transient_bytes"] < hbm
+            ),
+        }
+    return out
+
+
+@suite("wq_store")
+def run() -> dict:
+    from music_analyst_tpu.engines.checkpoint import last_load_stats
+    from music_analyst_tpu.engines.wq_cache import cache_stats
+    from music_analyst_tpu.models.distilbert import (
+        DistilBertClassifier,
+        DistilBertConfig,
+    )
+
+    if smoke():
+        cfg, batch, max_len = DistilBertConfig.tiny(), 64, 64
+    else:
+        cfg, batch, max_len = DistilBertConfig(), 4096, 128
+
+    work = tempfile.mkdtemp(prefix="wq-store-bench-")
+    try:
+        ckpt = os.path.join(work, "pytorch_model.bin")
+        _fabricate_checkpoint(cfg, ckpt)
+        cache_dir = os.path.join(work, "wq-cache")
+        qcfg = dataclasses.replace(cfg, weight_quant="int8")
+
+        t0 = time.perf_counter()
+        bf16 = DistilBertClassifier(
+            config=cfg, checkpoint_path=ckpt, max_len=max_len, seed=0
+        )
+        bf16_load_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        wq = DistilBertClassifier(
+            config=qcfg, checkpoint_path=ckpt, max_len=max_len, seed=0,
+            wq_cache_dir=cache_dir,
+        )
+        cold_s = time.perf_counter() - t0
+        cold = last_load_stats()
+
+        t0 = time.perf_counter()
+        wq_warm = DistilBertClassifier(
+            config=qcfg, checkpoint_path=ckpt, max_len=max_len, seed=0,
+            wq_cache_dir=cache_dir,
+        )
+        warm_s = time.perf_counter() - t0
+        warm = last_load_stats()
+
+        texts = [
+            f"song {i}: love and rain over the lonely city " * (1 + i % 4)
+            for i in range(batch)
+        ]
+        bf16_labels = bf16.classify_batch(texts)  # compile + dispatch
+        bf16_s, _ = timed(lambda: bf16.classify_batch(texts) or 0, repeats=2)
+        wq_labels = wq_warm.classify_batch(texts)
+        wq_s, _ = timed(lambda: wq_warm.classify_batch(texts) or 0, repeats=2)
+        del wq
+        agree = sum(a == b for a, b in zip(bf16_labels, wq_labels)) / batch
+
+        return {
+            "suite": "wq_store",
+            **device_info(),
+            "smoke": smoke(),
+            "model": "tiny" if smoke() else "DistilBERT full-size",
+            "scheme": "int8",
+            "batch": batch,
+            "max_len": max_len,
+            "bf16_load_s": round(bf16_load_s, 3),
+            "wq_cold_load_s": round(cold_s, 3),
+            "wq_warm_load_s": round(warm_s, 3),
+            "cold_cache": cold.get("cache"),
+            "warm_cache": warm.get("cache"),
+            "peak_host_staging_bytes": cold.get("peak_host_staging_bytes"),
+            "bf16_songs_per_s": round(batch / bf16_s, 1),
+            "wq_songs_per_s": round(batch / wq_s, 1),
+            "label_agreement": round(agree, 4),
+            "cache_stats": cache_stats(),
+            "fit_8b": _fit_8b(),
+            "note": (
+                "random weights — agreement reflects quant noise near the "
+                "decision threshold, not task accuracy; fit_8b is "
+                "lowering-level byte accounting (no 8B bytes move)"
+            ),
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)

@@ -178,6 +178,12 @@ def _add_sentiment(sub: argparse._SubParsersAction) -> None:
                    help="Batches staged ahead of the device in the "
                         "tokenize→transfer pipeline (default 2, or "
                         "$MUSICAAL_PREFETCH_DEPTH; 0 = no overlap)")
+    p.add_argument("--weight-quant", choices=("none", "int8", "int4"),
+                   default="none",
+                   help="Store model weights quantized on device "
+                        "(int8 per-channel / int4 grouped); checkpoints "
+                        "stream layer-by-layer through the quantized "
+                        "cache ($MUSICAAL_WQ_CACHE)")
     _add_telemetry_flags(p)
 
 
@@ -228,6 +234,10 @@ def _add_validate(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--min-agreement", type=float, default=None,
                    help="Exit non-zero when agreement falls below this "
                         "fraction (CI gate)")
+    p.add_argument("--weight-quant", choices=("none", "int8", "int4"),
+                   default="none",
+                   help="Validate the weight-quantized model against the "
+                        "float torch oracle (quantization quality gate)")
     _add_telemetry_flags(p)
 
 
@@ -351,6 +361,7 @@ def _dispatch(parser: argparse.ArgumentParser,
             model=args.model,
             limit=args.limit,
             output_dir=args.output_dir,
+            weight_quant=args.weight_quant,
         )
         if (args.min_agreement is not None
                 and report["agreement"] < args.min_agreement):
@@ -442,6 +453,14 @@ def _dispatch(parser: argparse.ArgumentParser,
                 "--length-buckets requires --model distilbert[-*] "
                 "(not --mock or decoder models)"
             )
+        if args.weight_quant != "none" and (
+            args.mock or not (args.model.startswith("distilbert")
+                              or args.model.startswith("llama"))
+        ):
+            parser.error(
+                "--weight-quant requires an on-device model family "
+                "(distilbert[-*] or llama[3*])"
+            )
         mesh = None
         if args.devices:
             from music_analyst_tpu.engines.sentiment import _mesh_capable
@@ -464,6 +483,7 @@ def _dispatch(parser: argparse.ArgumentParser,
                 mesh=mesh,
                 length_buckets=args.length_buckets,
                 prefetch_depth=args.prefetch_depth,
+                weight_quant=args.weight_quant,
             )
         return 0
 

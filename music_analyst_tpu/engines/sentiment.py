@@ -133,6 +133,7 @@ def get_backend(
     mock: bool = False,
     mesh=None,
     length_buckets: Optional[Sequence[int]] = None,
+    weight_quant: Optional[str] = None,
     **kwargs,
 ) -> ClassifierBackend:
     """Resolve the ``--model``/``--mock`` flag surface to a backend.
@@ -154,6 +155,17 @@ def get_backend(
             "length_buckets is an encoder-classifier option; "
             f"model {model!r} does not support it"
         )
+    has_wq = weight_quant not in (None, "none")
+    if has_wq and (
+        mock or not (model.startswith("distilbert")
+                     or model.startswith("llama"))
+    ):
+        # Same posture as length_buckets: silently running float would
+        # defeat the flag.
+        raise ValueError(
+            "weight_quant is an on-device model option; "
+            f"model {model!r} does not support it"
+        )
     if mock or model == "mock":
         from music_analyst_tpu.models.mock import MockKeywordClassifier
 
@@ -165,6 +177,8 @@ def get_backend(
         return OllamaClassifier(model=tag, **kwargs)
     if mesh is not None:
         kwargs["mesh"] = mesh
+    if has_wq:
+        kwargs["weight_quant"] = weight_quant
     try:
         if model.startswith("distilbert"):
             from music_analyst_tpu.models.distilbert import DistilBertClassifier
@@ -261,6 +275,7 @@ def run_sentiment(
     mesh=None,
     length_buckets: Optional[Sequence[int]] = None,
     prefetch_depth: Optional[int] = None,
+    weight_quant: Optional[str] = None,
 ) -> SentimentResult:
     """Classify the dataset and write the reference output artifacts.
 
@@ -292,7 +307,7 @@ def run_sentiment(
         return _run_sentiment_impl(
             tel, dataset_path, model, mock, limit, output_dir, batch_size,
             backend, quiet, resume, songs, mesh, length_buckets,
-            prefetch_depth,
+            prefetch_depth, weight_quant,
         )
 
 
@@ -318,7 +333,7 @@ def _timed_source(tel, source):
 def _run_sentiment_impl(
     tel, dataset_path, model, mock, limit, output_dir, batch_size,
     backend, quiet, resume, songs, mesh, length_buckets,
-    prefetch_depth,
+    prefetch_depth, weight_quant=None,
 ) -> SentimentResult:
     os.makedirs(output_dir, exist_ok=True)
     depth = resolve_prefetch_depth(prefetch_depth)
@@ -333,18 +348,21 @@ def _run_sentiment_impl(
 
         enable_persistent_compilation_cache()
     if backend is not None:
-        if mesh is not None or _has_buckets(length_buckets):
+        if (mesh is not None or _has_buckets(length_buckets)
+                or weight_quant not in (None, "none")):
             # An injected backend was constructed by the caller; silently
             # dropping construction-time options here would be a lie.
             raise ValueError(
-                "mesh=/length_buckets= configure backend construction and "
-                "cannot be combined with an explicit backend="
+                "mesh=/length_buckets=/weight_quant= configure backend "
+                "construction and cannot be combined with an explicit "
+                "backend="
             )
         clf = backend
     else:
         with tel.span("backend_init", model=model, mock=bool(mock)):
             clf = get_backend(
-                model, mock=mock, mesh=mesh, length_buckets=length_buckets
+                model, mock=mock, mesh=mesh, length_buckets=length_buckets,
+                weight_quant=weight_quant,
             )
     tel.annotate(backend=clf.name, batch_size=batch_size, prefetch_depth=depth)
 

@@ -213,6 +213,7 @@ def run_validation(
     checkpoint_path: Optional[str] = None,
     quiet: bool = False,
     backend=None,
+    weight_quant: Optional[str] = None,
 ):
     """Classify a slice with the TPU backend and with the HF torch oracle;
     return the agreement report (and write ``weight_validation.json``).
@@ -234,7 +235,7 @@ def run_validation(
             "pass checkpoint_path=)"
         )
     clf = backend if backend is not None else get_backend(
-        model, checkpoint_path=checkpoint_path
+        model, checkpoint_path=checkpoint_path, weight_quant=weight_quant
     )
     if not getattr(clf, "pretrained", False):
         raise RuntimeError(

@@ -58,6 +58,22 @@ class DistilBertConfig:
     # (ops/quant.py; ~2.1x bf16 matmul throughput per the roofline suite).
     # Inference-only; small logit perturbation bounded by tests/test_quant.py.
     quant: str = "none"
+    # "int8"/"int4" = stored weight-quantized projection/MLP kernels
+    # (QuantizedParam leaves; ops/quant.py).  Embeddings, norms, and the
+    # classifier heads stay float.  Mutually exclusive with `quant`.
+    weight_quant: str = "none"
+
+    def __post_init__(self):
+        if self.weight_quant not in ("none", "int8", "int4"):
+            raise ValueError(
+                f"weight_quant must be none/int8/int4, got "
+                f"{self.weight_quant!r}"
+            )
+        if self.weight_quant != "none" and self.quant != "none":
+            raise ValueError(
+                "weight_quant and dynamic quant are mutually exclusive — "
+                "the stored-weight path already runs the int8 MXU matmul"
+            )
 
     @classmethod
     def tiny(cls) -> "DistilBertConfig":
@@ -77,7 +93,7 @@ class TransformerBlock(nn.Module):
         attn_out = MultiHeadAttention(
             n_heads=cfg.n_heads, dtype=dtype, attn_impl=cfg.attn_impl,
             use_bias=True,  # HF DistilBERT q/k/v/out projections have biases
-            quant=cfg.quant,
+            quant=cfg.quant, weight_quant=cfg.weight_quant,
             name="attention",
         )(x, mask=None if cfg.attn_impl == "flash" else mask,
           lengths=lengths,
@@ -86,7 +102,7 @@ class TransformerBlock(nn.Module):
             name="sa_layer_norm", dtype=dtype, epsilon=LN_EPS
         )(x + attn_out)
         mlp_out = GeluMLP(cfg.hidden_dim, dtype=dtype, quant=cfg.quant,
-                          name="ffn")(x)
+                          weight_quant=cfg.weight_quant, name="ffn")(x)
         return nn.LayerNorm(
             name="output_layer_norm", dtype=dtype, epsilon=LN_EPS
         )(x + mlp_out)
@@ -179,65 +195,86 @@ class DistilBertForSentiment(nn.Module):
         return nn.Dense(cfg.n_classes, dtype=jnp.float32, name="classifier")(h)
 
 
-def load_hf_torch_checkpoint(params, path: str):
-    """Map an HF DistilBERT torch ``state_dict`` onto the Flax params.
+def iter_hf_param_units(params, path: str, mmap: bool = False):
+    """Stream an HF DistilBERT torch ``state_dict`` as layer-sized units.
 
-    Accepts a ``pytorch_model.bin`` path; kernel matrices transpose
-    (torch Linear stores ``[out, in]``), attention projections (weights AND
-    biases) reshape to the ``[dim, heads, head_dim]`` head layout.  Every
-    checkpoint tensor must be consumed — leftover keys raise, so a
+    Yields ``(unit_name, [("/"-joined tree path, np.ndarray), …])`` —
+    embeddings, then one unit per transformer layer, then the classifier
+    head — in the layout ``load_quantized_params`` consumes, so the
+    quantize-on-load path holds at most one unit of float tensors at a
+    time.  Kernel matrices transpose (torch Linear stores ``[out, in]``),
+    attention projections (weights AND biases) reshape to the
+    ``[dim, heads, head_dim]`` head layout.  Every checkpoint tensor must
+    be consumed — leftover keys raise at the end of the stream, so a
     checkpoint with unexpected structure can never silently half-load.
+    ``params`` supplies shapes only; ``ShapeDtypeStruct`` trees work.
     """
     import torch
 
-    sd = torch.load(path, map_location="cpu", weights_only=True)
-    cfg_heads = params["encoder"]["layer_0"]["attention"]["q_proj"]["kernel"].shape[1]
+    try:
+        sd = torch.load(path, map_location="cpu", weights_only=True,
+                        mmap=mmap)
+    except (RuntimeError, ValueError, TypeError):
+        # Non-zipfile (legacy) serialization or older torch: mmap
+        # unsupported — fall back to an eager read.
+        sd = torch.load(path, map_location="cpu", weights_only=True)
+    enc_shapes = params["encoder"]
+    cfg_heads = enc_shapes["layer_0"]["attention"]["q_proj"]["kernel"].shape[1]
+    dim = enc_shapes["word_embeddings"]["embedding"].shape[1]
+    head_dim = dim // cfg_heads
     consumed = set()
 
     def t(name):
         consumed.add(name)
         return np.asarray(sd[name].numpy())
 
-    new = jax.tree_util.tree_map(lambda x: x, params)  # shallow copy
-    enc = new["encoder"]
-    enc["word_embeddings"]["embedding"] = t(
-        "distilbert.embeddings.word_embeddings.weight"
-    )
-    enc["position_embeddings"]["embedding"] = t(
-        "distilbert.embeddings.position_embeddings.weight"
-    )
-    enc["embed_layer_norm"]["scale"] = t("distilbert.embeddings.LayerNorm.weight")
-    enc["embed_layer_norm"]["bias"] = t("distilbert.embeddings.LayerNorm.bias")
-    n_layers = sum(1 for k in enc if k.startswith("layer_"))
+    yield "embeddings", [
+        ("encoder/word_embeddings/embedding",
+         t("distilbert.embeddings.word_embeddings.weight")),
+        ("encoder/position_embeddings/embedding",
+         t("distilbert.embeddings.position_embeddings.weight")),
+        ("encoder/embed_layer_norm/scale",
+         t("distilbert.embeddings.LayerNorm.weight")),
+        ("encoder/embed_layer_norm/bias",
+         t("distilbert.embeddings.LayerNorm.bias")),
+    ]
+    n_layers = sum(1 for k in enc_shapes if k.startswith("layer_"))
     for i in range(n_layers):
         hf = f"distilbert.transformer.layer.{i}"
-        layer = enc[f"layer_{i}"]
-        attn = layer["attention"]
-        dim = enc["word_embeddings"]["embedding"].shape[1]
-        head_dim = dim // cfg_heads
+        p = f"encoder/layer_{i}"
+        leaves = []
         for ours, theirs in (("q_proj", "q_lin"), ("k_proj", "k_lin"),
                              ("v_proj", "v_lin")):
             w = t(f"{hf}.attention.{theirs}.weight").T  # [in, out]
-            attn[ours]["kernel"] = w.reshape(dim, cfg_heads, head_dim)
-            attn[ours]["bias"] = t(f"{hf}.attention.{theirs}.bias").reshape(
-                cfg_heads, head_dim
-            )
-        attn["o_proj"]["kernel"] = (
-            t(f"{hf}.attention.out_lin.weight").T.reshape(cfg_heads, head_dim, dim)
-        )
-        attn["o_proj"]["bias"] = t(f"{hf}.attention.out_lin.bias")
-        layer["sa_layer_norm"]["scale"] = t(f"{hf}.sa_layer_norm.weight")
-        layer["sa_layer_norm"]["bias"] = t(f"{hf}.sa_layer_norm.bias")
-        layer["ffn"]["lin1"]["kernel"] = t(f"{hf}.ffn.lin1.weight").T
-        layer["ffn"]["lin1"]["bias"] = t(f"{hf}.ffn.lin1.bias")
-        layer["ffn"]["lin2"]["kernel"] = t(f"{hf}.ffn.lin2.weight").T
-        layer["ffn"]["lin2"]["bias"] = t(f"{hf}.ffn.lin2.bias")
-        layer["output_layer_norm"]["scale"] = t(f"{hf}.output_layer_norm.weight")
-        layer["output_layer_norm"]["bias"] = t(f"{hf}.output_layer_norm.bias")
-    new["pre_classifier"]["kernel"] = t("pre_classifier.weight").T
-    new["pre_classifier"]["bias"] = t("pre_classifier.bias")
-    new["classifier"]["kernel"] = t("classifier.weight").T
-    new["classifier"]["bias"] = t("classifier.bias")
+            leaves.append((f"{p}/attention/{ours}/kernel",
+                           w.reshape(dim, cfg_heads, head_dim)))
+            leaves.append((f"{p}/attention/{ours}/bias",
+                           t(f"{hf}.attention.{theirs}.bias").reshape(
+                               cfg_heads, head_dim)))
+        leaves.append((f"{p}/attention/o_proj/kernel",
+                       t(f"{hf}.attention.out_lin.weight").T.reshape(
+                           cfg_heads, head_dim, dim)))
+        leaves.append((f"{p}/attention/o_proj/bias",
+                       t(f"{hf}.attention.out_lin.bias")))
+        leaves.append((f"{p}/sa_layer_norm/scale",
+                       t(f"{hf}.sa_layer_norm.weight")))
+        leaves.append((f"{p}/sa_layer_norm/bias",
+                       t(f"{hf}.sa_layer_norm.bias")))
+        leaves.append((f"{p}/ffn/lin1/kernel", t(f"{hf}.ffn.lin1.weight").T))
+        leaves.append((f"{p}/ffn/lin1/bias", t(f"{hf}.ffn.lin1.bias")))
+        leaves.append((f"{p}/ffn/lin2/kernel", t(f"{hf}.ffn.lin2.weight").T))
+        leaves.append((f"{p}/ffn/lin2/bias", t(f"{hf}.ffn.lin2.bias")))
+        leaves.append((f"{p}/output_layer_norm/scale",
+                       t(f"{hf}.output_layer_norm.weight")))
+        leaves.append((f"{p}/output_layer_norm/bias",
+                       t(f"{hf}.output_layer_norm.bias")))
+        yield f"layer_{i}", leaves
+    yield "head", [
+        ("pre_classifier/kernel", t("pre_classifier.weight").T),
+        ("pre_classifier/bias", t("pre_classifier.bias")),
+        ("classifier/kernel", t("classifier.weight").T),
+        ("classifier/bias", t("classifier.bias")),
+    ]
     # Non-parameter buffers some transformers versions serialize.
     ignorable = {k for k in sd if k.endswith("position_ids")}
     leftovers = set(sd) - consumed - ignorable
@@ -246,6 +283,22 @@ def load_hf_torch_checkpoint(params, path: str):
             "checkpoint keys not consumed by the DistilBERT mapping: "
             + ", ".join(sorted(leftovers)[:8])
         )
+
+
+def load_hf_torch_checkpoint(params, path: str):
+    """Map an HF DistilBERT torch ``state_dict`` onto the Flax params.
+
+    Eager wrapper over ``iter_hf_param_units`` — see it for the mapping
+    contract (transposes, head-layout reshapes, consumed-keys check).
+    """
+    new = jax.tree_util.tree_map(lambda x: x, params)  # shallow copy
+    for _, leaves in iter_hf_param_units(params, path):
+        for tree_path, arr in leaves:
+            parts = tree_path.split("/")
+            node = new
+            for part in parts[:-1]:
+                node = node[part]
+            node[parts[-1]] = arr
     return new
 
 
@@ -378,6 +431,7 @@ class DistilBertClassifier(ClassifierBackend):
         vocab_path: Optional[str] = None,
         length_buckets: Optional[Sequence[int]] = None,
         packed: bool = False,
+        wq_cache_dir: Optional[str] = None,
     ) -> None:
         self.config = config or DistilBertConfig()
         self.max_len = max_len
@@ -412,11 +466,55 @@ class DistilBertClassifier(ClassifierBackend):
             jnp.zeros((1, max_len), jnp.int32),
             jnp.ones((1,), jnp.int32),
         )
-        self.params = self.model.init(jax.random.key(seed), *dummy)["params"]
-        self.pretrained = False
-        if checkpoint_path:
-            self.params = load_hf_torch_checkpoint(self.params, checkpoint_path)
+        wq = self.config.weight_quant
+        if checkpoint_path and wq != "none":
+            # Streaming quantize-on-load: the float tree is never
+            # materialized — only per-unit shapes via eval_shape, then the
+            # layer-by-layer quantize→H2D pipeline (engines/checkpoint.py).
+            from music_analyst_tpu.engines import wq_cache
+            from music_analyst_tpu.engines.checkpoint import (
+                load_quantized_params,
+            )
+            from music_analyst_tpu.ops.quant import WQ_DEFAULT_GROUP
+
+            params_shape = jax.eval_shape(
+                self.model.init, jax.random.key(seed), *dummy
+            )["params"]
+            cache_dir = wq_cache.resolve_cache_dir(wq_cache_dir)
+            cache_key = (
+                wq_cache.wq_key(checkpoint_path, "distilbert", wq,
+                                WQ_DEFAULT_GROUP)
+                if cache_dir else None
+            )
+            self.params = load_quantized_params(
+                params_shape,
+                lambda: iter_hf_param_units(
+                    params_shape, checkpoint_path, mmap=True
+                ),
+                wq,
+                group_size=WQ_DEFAULT_GROUP,
+                mesh=mesh,
+                cache_dir=cache_dir,
+                cache_key=cache_key,
+            )
             self.pretrained = True
+        else:
+            self.params = self.model.init(
+                jax.random.key(seed), *dummy
+            )["params"]
+            self.pretrained = False
+            if checkpoint_path:
+                self.params = load_hf_torch_checkpoint(
+                    self.params, checkpoint_path
+                )
+                self.pretrained = True
+            if wq != "none":
+                from music_analyst_tpu.ops.quant import (
+                    WQ_DEFAULT_GROUP,
+                    quantize_tree,
+                )
+
+                self.params = quantize_tree(self.params, wq, WQ_DEFAULT_GROUP)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -538,6 +636,11 @@ class DistilBertClassifier(ClassifierBackend):
         if quant != "none":
             config = dataclasses.replace(
                 config or DistilBertConfig(), quant=quant
+            )
+        weight_quant = kwargs.pop("weight_quant", "none") or "none"
+        if weight_quant != "none":
+            config = dataclasses.replace(
+                config or DistilBertConfig(), weight_quant=weight_quant
             )
         return cls(config=config, checkpoint_path=ckpt, **kwargs)
 

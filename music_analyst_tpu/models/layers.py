@@ -192,6 +192,108 @@ class QuantDenseGeneral(nn.Module):
         return fn(x, kernel, bias, out_dtype=self.dtype)
 
 
+class WqDenseGeneral(nn.Module):
+    """DenseGeneral over a *stored* weight-quantized kernel.
+
+    Same two layouts (and identical param names, shapes, and init) as
+    ``nn.DenseGeneral``/``QuantDenseGeneral``, but the ``kernel`` slot may
+    hold a ``QuantizedParam`` (ops/quant.py): int8 or packed-int4 codes +
+    scales, dequant fused into the matmul epilogue (w8/w4 stored,
+    activations dynamically row-quantized inside the op).  With a plain
+    float array in the slot (random init, bf16 A/B baselines) it computes
+    the ordinary float contraction, so one module serves both.
+
+    The kernel is read through ``scope.get_variable`` rather than
+    ``self.param`` when a QuantizedParam is stored: packed int4 halves
+    axis 0, which Flax's declared-shape check would (correctly) reject for
+    a plain param — the quantized store is a different *representation* of
+    the declared kernel, not a different kernel.
+    """
+
+    features: Any          # int or tuple, as nn.DenseGeneral
+    axis: Any = -1         # -1 or (-2, -1)
+    use_bias: bool = True
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from music_analyst_tpu.ops.quant import (
+            QuantizedParam,
+            wq_dense_axis_last,
+            wq_dense_axis_last2,
+        )
+
+        feat = (
+            (self.features,)
+            if isinstance(self.features, int)
+            else tuple(self.features)
+        )
+        if self.axis == -1:
+            kshape = (x.shape[-1],) + feat
+            n_contract = 1
+        elif not isinstance(self.axis, int) and tuple(self.axis) == (-2, -1):
+            assert len(feat) == 1
+            kshape = (x.shape[-2], x.shape[-1], feat[0])
+            n_contract = 2
+        else:
+            raise ValueError(f"unsupported axis {self.axis!r}")
+
+        def kernel_init(key, shape, dtype):
+            # Same flattened-fan-in init as QuantDenseGeneral (see above).
+            import numpy as _np
+
+            flat = (
+                int(_np.prod(shape[:n_contract])),
+                int(_np.prod(shape[n_contract:])),
+            )
+            return nn.initializers.lecun_normal()(key, flat, dtype).reshape(
+                shape
+            )
+
+        kernel = None
+        if self.scope is not None and self.scope.has_variable(
+            "params", "kernel"
+        ):
+            stored = self.scope.get_variable("params", "kernel")
+            if isinstance(stored, QuantizedParam):
+                kernel = stored
+        if kernel is None:
+            kernel = self.param("kernel", kernel_init, kshape, jnp.float32)
+        bias = (
+            self.param("bias", nn.initializers.zeros, feat, jnp.float32)
+            if self.use_bias
+            else None
+        )
+        if isinstance(kernel, QuantizedParam):
+            fn = (
+                wq_dense_axis_last if self.axis == -1 else wq_dense_axis_last2
+            )
+            return fn(x, kernel, bias, out_dtype=self.dtype)
+        # Float fallback: the contraction nn.DenseGeneral performs.
+        xd = x.astype(self.dtype)
+        kd = kernel.astype(self.dtype)
+        contract = (
+            ((xd.ndim - 1,), (0,))
+            if n_contract == 1
+            else ((xd.ndim - 2, xd.ndim - 1), (0, 1))
+        )
+        out = jax.lax.dot_general(xd, kd, (contract, ((), ())))
+        if bias is not None:
+            out = out + bias.astype(self.dtype)
+        return out.astype(self.dtype)
+
+
+def pick_dense_cls(weight_quant: str, quant: str):
+    """One projection-class decision shared by every model family: stored
+    weight-quant wins (it subsumes the matmul), then dynamic int8, then
+    plain float."""
+    if weight_quant != "none":
+        return WqDenseGeneral
+    if quant == "int8":
+        return QuantDenseGeneral
+    return nn.DenseGeneral
+
+
 class MultiHeadAttention(nn.Module):
     """MHA/GQA with optional RoPE and optional KV cache.
 
@@ -219,6 +321,10 @@ class MultiHeadAttention(nn.Module):
     # "int8" routes the Q/K/V/O projections through the dynamic int8
     # matmul (ops/quant.py) — inference-only MXU throughput lever.
     quant: str = "none"
+    # "int8"/"int4" stores the projection kernels weight-quantized
+    # (QuantizedParam leaves; ops/quant.py) — takes precedence over the
+    # dynamic `quant` path.
+    weight_quant: str = "none"
 
     @nn.compact
     def __call__(
@@ -233,9 +339,7 @@ class MultiHeadAttention(nn.Module):
         features = x.shape[-1]
         n_kv = self.n_kv_heads or self.n_heads
         head_dim = self.head_dim or features // self.n_heads
-        dense_cls = (
-            QuantDenseGeneral if self.quant == "int8" else nn.DenseGeneral
-        )
+        dense_cls = pick_dense_cls(self.weight_quant, self.quant)
         dense = lambda feats, name: dense_cls(  # noqa: E731
             features=feats,
             axis=-1,
@@ -307,11 +411,16 @@ class SwiGLU(nn.Module):
     hidden_dim: int
     dtype: jnp.dtype = jnp.bfloat16
     quant: str = "none"
+    weight_quant: str = "none"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         features = x.shape[-1]
-        if self.quant == "int8":
+        if self.weight_quant != "none":
+            dense = lambda feats, name: WqDenseGeneral(  # noqa: E731
+                features=feats, use_bias=False, dtype=self.dtype, name=name
+            )
+        elif self.quant == "int8":
             dense = lambda feats, name: QuantDenseGeneral(  # noqa: E731
                 features=feats, use_bias=False, dtype=self.dtype, name=name
             )
@@ -330,11 +439,16 @@ class GeluMLP(nn.Module):
     hidden_dim: int
     dtype: jnp.dtype = jnp.bfloat16
     quant: str = "none"
+    weight_quant: str = "none"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         features = x.shape[-1]
-        if self.quant == "int8":
+        if self.weight_quant != "none":
+            dense = lambda feats, name: WqDenseGeneral(  # noqa: E731
+                features=feats, dtype=self.dtype, name=name
+            )
+        elif self.quant == "int8":
             dense = lambda feats, name: QuantDenseGeneral(  # noqa: E731
                 features=feats, dtype=self.dtype, name=name
             )

@@ -79,6 +79,28 @@ class LlamaConfig:
     # "int8" routes attention/MLP projections through the dynamic int8
     # matmul (ops/quant.py) — inference-only; see DistilBertConfig.quant.
     quant: str = "none"
+    # "int8"/"int4" stores projection + lm_head kernels weight-quantized
+    # (QuantizedParam leaves; ops/quant.py): the bf16 tree never exists,
+    # which is what lets the 8B config fit one 16 GB chip.  Mutually
+    # exclusive with the dynamic `quant` path (it subsumes the matmul).
+    weight_quant: str = "none"
+
+    def __post_init__(self):
+        if self.weight_quant not in ("none", "int8", "int4"):
+            raise ValueError(
+                f"weight_quant must be none/int8/int4, got "
+                f"{self.weight_quant!r}"
+            )
+        if self.weight_quant != "none" and self.quant != "none":
+            raise ValueError(
+                "weight_quant and dynamic quant are mutually exclusive — "
+                "the stored-weight path already runs the int8 MXU matmul"
+            )
+        if self.weight_quant != "none" and self.n_experts > 0:
+            raise ValueError(
+                "weight_quant does not cover the MoE expert stacks yet; "
+                "use the dynamic quant='int8' path for MoE configs"
+            )
 
     @classmethod
     def llama3_8b(cls) -> "LlamaConfig":
@@ -132,6 +154,7 @@ class LlamaBlock(nn.Module):
             attn_impl=cfg.attn_impl,
             flash_causal=True,
             quant=cfg.quant,
+            weight_quant=cfg.weight_quant,
             name="attention",
         )
         h = RMSNorm(name="attention_norm")(x)
@@ -171,7 +194,7 @@ class LlamaBlock(nn.Module):
             )
         else:
             ffn = SwiGLU(cfg.hidden_dim, dtype=dtype, quant=cfg.quant,
-                         name="feed_forward")
+                         weight_quant=cfg.weight_quant, name="feed_forward")
         x = x + ffn(h)
         return x, new_cache
 
@@ -222,8 +245,16 @@ class LlamaModel(nn.Module):
             x = jnp.take_along_axis(
                 x, last_position[:, None, None].astype(jnp.int32), axis=1
             )
-        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
-                          name="lm_head")(x)
+        if cfg.weight_quant != "none":
+            from music_analyst_tpu.models.layers import WqDenseGeneral
+
+            logits = WqDenseGeneral(
+                features=cfg.vocab_size, axis=-1, use_bias=False,
+                dtype=jnp.float32, name="lm_head",
+            )(x)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                              dtype=jnp.float32, name="lm_head")(x)
         return logits, (new_caches if caches is not None else None)
 
 
@@ -237,13 +268,19 @@ def init_caches(
     ]
 
 
-def load_torch_state_dict(path: str) -> dict:
+def load_torch_state_dict(path: str, mmap: bool = False) -> dict:
     """Merge a ``pytorch_model.bin``-style file or a directory of shards
     (``pytorch_model*.bin`` / ``*.pt``) into one raw state dict.
 
     Shared by the Flax param mapper below and the validation harness's
     transformers oracle (``engines/validate.py``), so both sides of a
     label-agreement report read the checkpoint identically.
+
+    ``mmap=True`` (the streaming quantize-on-load path) keeps tensor
+    storage memory-mapped: pages materialize per-tensor as the per-unit
+    iterator touches them, so peak host memory stays O(one layer) instead
+    of O(checkpoint).  Falls back to an eager load for formats torch
+    cannot mmap (legacy non-zip archives).
     """
     import torch
 
@@ -266,7 +303,16 @@ def load_torch_state_dict(path: str) -> dict:
     sd = {}
     for shard in shards:
         try:
-            loaded = torch.load(shard, map_location="cpu", weights_only=True)
+            if mmap:
+                try:
+                    loaded = torch.load(shard, map_location="cpu",
+                                        weights_only=True, mmap=True)
+                except (RuntimeError, ValueError):
+                    loaded = torch.load(shard, map_location="cpu",
+                                        weights_only=True)
+            else:
+                loaded = torch.load(shard, map_location="cpu",
+                                    weights_only=True)
         except Exception as exc:
             # Never skip silently: a truncated weight shard skipped here
             # would surface as a confusing missing-key error (or worse,
@@ -281,23 +327,24 @@ def load_torch_state_dict(path: str) -> dict:
     return sd
 
 
-def load_hf_torch_checkpoint(params, path: str):
-    """Map an HF ``LlamaForCausalLM`` torch state_dict onto the Flax params.
+def iter_hf_param_units(params, path: str, mmap: bool = False):
+    """Yield an HF ``LlamaForCausalLM`` checkpoint as per-unit leaf lists.
 
-    ``path`` is a ``pytorch_model.bin``-style file or a directory of such
-    shards (``pytorch_model*.bin`` / ``*.pt``).  torch Linear kernels
+    The single definition of the torch→Flax mapping: torch Linear kernels
     ``[out, in]`` transpose to ``[in, out]``; attention projections reshape
     to ``[dim, heads, head_dim]``.  The RoPE convention needs no weight
     permutation: HF's ``rotate_half`` splits the head dim into contiguous
     halves, exactly as ``layers.apply_rope`` does.
 
-    Replaces nothing in the reference — its large-model path is a remote
-    Ollama server (``scripts/sentiment_classifier.py:85-100``); here the
-    weights become first-class on-device arrays.
+    Yields ``(unit_name, [(tree_path, np.ndarray), …])`` one decoder layer
+    (or embeddings / final norm / lm_head) at a time — the granularity the
+    streaming quantize-on-load pipeline (``engines/checkpoint.py``)
+    overlaps; with ``mmap=True`` only each unit's tensors are ever paged
+    in.  ``params`` provides shapes only — ``ShapeDtypeStruct`` trees work.
     """
     import torch
 
-    sd = load_torch_state_dict(path)
+    sd = load_torch_state_dict(path, mmap=mmap)
     # Tolerate both bare-model ("model.layers...") and prefixed keys.
     sd = { (k[len("model."):] if k.startswith("model.") else k): v
            for k, v in sd.items() }
@@ -305,49 +352,86 @@ def load_hf_torch_checkpoint(params, path: str):
     def t(name):
         return np.asarray(sd[name].to(torch.float32).numpy())
 
-    new = jax.tree_util.tree_map(lambda x: x, params)  # shallow copy
-    dim = new["tok_embeddings"]["embedding"].shape[1]
+    dim = params["tok_embeddings"]["embedding"].shape[1]
     embed = t("embed_tokens.weight")
-    want = new["tok_embeddings"]["embedding"].shape
+    want = tuple(params["tok_embeddings"]["embedding"].shape)
     if embed.shape != want:
         raise ValueError(
             f"checkpoint embed_tokens is {embed.shape} but the model config "
             f"expects {want} — config (vocab_size/dim) doesn't match the "
             "checkpoint"
         )
-    new["tok_embeddings"]["embedding"] = embed
-    n_layers = sum(1 for k in new if k.startswith("layer_"))
+    yield "tok_embeddings", [("tok_embeddings/embedding", embed)]
+    n_layers = sum(1 for k in params if k.startswith("layer_"))
     for i in range(n_layers):
         hf = f"layers.{i}"
-        layer = new[f"layer_{i}"]
-        attn = layer["attention"]
+        attn = params[f"layer_{i}"]["attention"]
         n_heads = attn["q_proj"]["kernel"].shape[1]
         n_kv = attn["k_proj"]["kernel"].shape[1]
         head_dim = attn["q_proj"]["kernel"].shape[2]
-        attn["q_proj"]["kernel"] = (
-            t(f"{hf}.self_attn.q_proj.weight").T.reshape(dim, n_heads, head_dim)
-        )
-        attn["k_proj"]["kernel"] = (
-            t(f"{hf}.self_attn.k_proj.weight").T.reshape(dim, n_kv, head_dim)
-        )
-        attn["v_proj"]["kernel"] = (
-            t(f"{hf}.self_attn.v_proj.weight").T.reshape(dim, n_kv, head_dim)
-        )
-        attn["o_proj"]["kernel"] = (
-            t(f"{hf}.self_attn.o_proj.weight").T.reshape(n_heads, head_dim, dim)
-        )
-        layer["attention_norm"]["scale"] = t(f"{hf}.input_layernorm.weight")
-        layer["ffn_norm"]["scale"] = t(f"{hf}.post_attention_layernorm.weight")
-        ffn = layer["feed_forward"]
-        ffn["gate_proj"]["kernel"] = t(f"{hf}.mlp.gate_proj.weight").T
-        ffn["up_proj"]["kernel"] = t(f"{hf}.mlp.up_proj.weight").T
-        ffn["down_proj"]["kernel"] = t(f"{hf}.mlp.down_proj.weight").T
-    new["norm"]["scale"] = t("norm.weight")
+        pre = f"layer_{i}"
+        leaves = [
+            (f"{pre}/attention/q_proj/kernel",
+             t(f"{hf}.self_attn.q_proj.weight").T.reshape(
+                 dim, n_heads, head_dim)),
+            (f"{pre}/attention/k_proj/kernel",
+             t(f"{hf}.self_attn.k_proj.weight").T.reshape(
+                 dim, n_kv, head_dim)),
+            (f"{pre}/attention/v_proj/kernel",
+             t(f"{hf}.self_attn.v_proj.weight").T.reshape(
+                 dim, n_kv, head_dim)),
+            (f"{pre}/attention/o_proj/kernel",
+             t(f"{hf}.self_attn.o_proj.weight").T.reshape(
+                 n_heads, head_dim, dim)),
+            (f"{pre}/attention_norm/scale", t(f"{hf}.input_layernorm.weight")),
+            (f"{pre}/ffn_norm/scale",
+             t(f"{hf}.post_attention_layernorm.weight")),
+            (f"{pre}/feed_forward/gate_proj/kernel",
+             t(f"{hf}.mlp.gate_proj.weight").T),
+            (f"{pre}/feed_forward/up_proj/kernel",
+             t(f"{hf}.mlp.up_proj.weight").T),
+            (f"{pre}/feed_forward/down_proj/kernel",
+             t(f"{hf}.mlp.down_proj.weight").T),
+        ]
+        yield pre, leaves
+    yield "norm", [("norm/scale", t("norm.weight"))]
     if "lm_head.weight" in sd:
-        new["lm_head"]["kernel"] = t("lm_head.weight").T
+        lm = t("lm_head.weight").T
     else:  # tied embeddings (Llama-3.2 style)
-        new["lm_head"]["kernel"] = t("embed_tokens.weight").T
+        lm = t("embed_tokens.weight").T
+    yield "lm_head", [("lm_head/kernel", lm)]
+
+
+def _set_tree_path(tree, path: str, leaf):
+    parts = path.split("/")
+    node = tree
+    for part in parts[:-1]:
+        node = node[part]
+    node[parts[-1]] = leaf
+
+
+def load_hf_torch_checkpoint(params, path: str):
+    """Map an HF ``LlamaForCausalLM`` torch state_dict onto the Flax params.
+
+    Eager wrapper over :func:`iter_hf_param_units` (one mapping
+    definition; the streaming quantized loader consumes the iterator
+    directly).  Replaces nothing in the reference — its large-model path
+    is a remote Ollama server (``scripts/sentiment_classifier.py:85-100``);
+    here the weights become first-class on-device arrays.
+    """
+    new = jax.tree_util.tree_map(lambda x: x, params)  # shallow copy
+    for _, leaves in iter_hf_param_units(new, path):
+        for tree_path, leaf in leaves:
+            _set_tree_path(new, tree_path, leaf)
     return new
+
+
+def _wq_group_size() -> int:
+    """One group-size definition per family so the cache key, the loader,
+    and the random-init quantizer can never disagree."""
+    from music_analyst_tpu.ops.quant import WQ_DEFAULT_GROUP
+
+    return WQ_DEFAULT_GROUP
 
 
 class LlamaZeroShotClassifier(ClassifierBackend):
@@ -363,6 +447,7 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         mesh=None,
         seed: int = 0,
         decode_mode: str = "score",
+        wq_cache_dir: Optional[str] = None,
     ) -> None:
         if decode_mode not in ("score", "generate"):
             raise ValueError(
@@ -393,23 +478,68 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         dummy_ids = jnp.zeros((1, 8), jnp.int32)
         dummy_pos = jnp.zeros((1, 8), jnp.int32)
         dummy_mask = causal_mask(8, 8, 0)
-        self.params = self.model.init(
-            jax.random.key(seed), dummy_ids, dummy_pos, dummy_mask
-        )["params"]
+        wq = self.config.weight_quant
         self.pretrained = False
-        if checkpoint_path:
-            self.params = load_hf_torch_checkpoint(self.params, checkpoint_path)
-            self.pretrained = True
-            if isinstance(self.tokenizer, ByteTokenizer):
-                import warnings
+        if checkpoint_path and wq != "none":
+            # Streaming quantize-on-load: the float tree is never
+            # materialized — shapes come from eval_shape, checkpoint
+            # tensors stream through quantize→H2D one layer at a time,
+            # and a warm wq-cache hit skips torch entirely.
+            from music_analyst_tpu.engines import wq_cache
+            from music_analyst_tpu.engines.checkpoint import (
+                load_quantized_params,
+            )
 
-                warnings.warn(
-                    "real checkpoint loaded but no matching tokenizer found "
-                    "— byte-level ids won't line up with the checkpoint's "
-                    "BPE vocabulary; set MUSICAAL_LLAMA_TOKENIZER to the "
-                    "checkpoint's tokenizer directory for meaningful labels",
-                    stacklevel=2,
+            params_shape = jax.eval_shape(
+                self.model.init, jax.random.key(seed), dummy_ids,
+                dummy_pos, dummy_mask,
+            )["params"]
+            cache_dir = wq_cache.resolve_cache_dir(wq_cache_dir)
+            cache_key = (
+                wq_cache.wq_key(checkpoint_path, "llama", wq,
+                                _wq_group_size())
+                if cache_dir else None
+            )
+            self.params = load_quantized_params(
+                params_shape,
+                lambda: iter_hf_param_units(
+                    params_shape, checkpoint_path, mmap=True
+                ),
+                wq,
+                group_size=_wq_group_size(),
+                mesh=mesh,
+                cache_dir=cache_dir,
+                cache_key=cache_key,
+            )
+            self.pretrained = True
+        else:
+            self.params = self.model.init(
+                jax.random.key(seed), dummy_ids, dummy_pos, dummy_mask
+            )["params"]
+            if checkpoint_path:
+                self.params = load_hf_torch_checkpoint(
+                    self.params, checkpoint_path
                 )
+                self.pretrained = True
+            if wq != "none":
+                # Random-init WQ model (smoke/A-B runs): quantize the
+                # just-initialized tree in place so the forward exercises
+                # the exact stored-weight path a checkpoint load produces.
+                from music_analyst_tpu.ops.quant import quantize_tree
+
+                self.params = quantize_tree(
+                    self.params, wq, _wq_group_size()
+                )
+        if self.pretrained and isinstance(self.tokenizer, ByteTokenizer):
+            import warnings
+
+            warnings.warn(
+                "real checkpoint loaded but no matching tokenizer found "
+                "— byte-level ids won't line up with the checkpoint's "
+                "BPE vocabulary; set MUSICAAL_LLAMA_TOKENIZER to the "
+                "checkpoint's tokenizer directory for meaningful labels",
+                stacklevel=2,
+            )
         self.mesh = mesh
         if mesh is not None:
             from music_analyst_tpu.parallel.sharding import shard_params
@@ -593,6 +723,9 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         config = kwargs.pop("config", None) or preset()
         if quant != "none":
             config = dataclasses.replace(config, quant=quant)
+        weight_quant = kwargs.pop("weight_quant", "none") or "none"
+        if weight_quant != "none":
+            config = dataclasses.replace(config, weight_quant=weight_quant)
         ckpt = kwargs.pop("checkpoint_path", None) or os.environ.get(
             "MUSICAAL_LLAMA_CKPT"
         )

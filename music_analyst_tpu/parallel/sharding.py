@@ -57,14 +57,56 @@ def spec_for_path(path: str, rules=None) -> P:
 def _path_str(path) -> str:
     parts = []
     for p in path:
-        parts.append(str(getattr(p, "key", getattr(p, "idx", p))))
+        part = getattr(p, "key", None)
+        if part is None:
+            part = getattr(p, "idx", None)
+        if part is None:
+            # register_dataclass fields flatten with GetAttrKey(.name)
+            part = getattr(p, "name", None)
+        parts.append(str(p if part is None else part))
     return "/".join(parts)
 
 
+def _is_quantized(leaf) -> bool:
+    from music_analyst_tpu.ops.quant import QuantizedParam
+
+    return isinstance(leaf, QuantizedParam)
+
+
+def _quantized_specs(qp, base: P):
+    """Spec-holding QuantizedParam for a stored-quantized kernel.
+
+    ``q`` keeps the float kernel's rule (same rank — int4 halves axis 0
+    but keeps head/hidden divisibility, e.g. 8B o_proj heads 32→16 still
+    split by tp=4); ``scale`` replicates its leading group axis and
+    inherits the kernel's *feature*-axis placement so the epilogue
+    multiply needs no resharding.  Meta fields are preserved, so the spec
+    tree stays structure-congruent with the param tree.
+    """
+    import dataclasses
+
+    padded = tuple(base) + (None,) * (len(qp.shape) - len(tuple(base)))
+    scale_spec = P(None, *padded[qp.n_contract:])
+    return dataclasses.replace(qp, q=base, scale=scale_spec)
+
+
 def partition_specs(params, rules=None):
-    """PartitionSpec pytree matching ``params``."""
+    """PartitionSpec pytree matching ``params``.
+
+    ``QuantizedParam`` leaves are resolved atomically — the rule lookup
+    sees the kernel's tree path (".../kernel"), not the dataclass's inner
+    ``q``/``scale`` fields — and come back as a QuantizedParam holding one
+    spec per data field.
+    """
+
+    def _spec(path, leaf):
+        spec = spec_for_path(_path_str(path), rules)
+        if _is_quantized(leaf):
+            return _quantized_specs(leaf, spec)
+        return spec
+
     return jax.tree_util.tree_map_with_path(
-        lambda path, _: spec_for_path(_path_str(path), rules), params
+        _spec, params, is_leaf=lambda x: _is_quantized(x)
     )
 
 
@@ -86,8 +128,27 @@ def shard_params(params, mesh: Mesh, rules=None, drop_unused_axes: bool = True):
 
     def _place(path, leaf):
         spec = spec_for_path(_path_str(path), rules)
+        if _is_quantized(leaf):
+            import dataclasses
+
+            specs = _quantized_specs(leaf, spec)
+            if drop_unused_axes:
+                specs = dataclasses.replace(
+                    specs,
+                    q=prune_spec(specs.q, axis_names),
+                    scale=prune_spec(specs.scale, axis_names),
+                )
+            return dataclasses.replace(
+                leaf,
+                q=jax.device_put(leaf.q, NamedSharding(mesh, specs.q)),
+                scale=jax.device_put(
+                    leaf.scale, NamedSharding(mesh, specs.scale)
+                ),
+            )
         if drop_unused_axes:
             spec = prune_spec(spec, axis_names)
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
-    return jax.tree_util.tree_map_with_path(_place, params)
+    return jax.tree_util.tree_map_with_path(
+        _place, params, is_leaf=lambda x: _is_quantized(x)
+    )

@@ -53,6 +53,35 @@ def _aval_key(args: tuple, kwargs: dict) -> str:
     return ";".join(_leaf_sig(leaf) for leaf in leaves) + f"#{treedef}"
 
 
+def _wq_param_bytes(args: tuple, kwargs: dict) -> Optional[Dict[str, int]]:
+    """Param-bytes breakdown of the call's weight-quantized argument
+    trees (stored int codes+scales vs the float bytes a dequantizing
+    epilogue transiently touches), or ``None`` for all-float calls —
+    the field only appears once quantization is actually in play."""
+    try:
+        from music_analyst_tpu.ops.quant import (
+            QuantizedParam,
+            param_tree_bytes,
+        )
+
+        def _has_qp(tree) -> bool:
+            return any(
+                isinstance(leaf, QuantizedParam)
+                for leaf in jax.tree_util.tree_leaves(
+                    tree, is_leaf=lambda x: isinstance(x, QuantizedParam)
+                )
+            )
+
+        trees = [
+            a for a in list(args) + list(kwargs.values()) if _has_qp(a)
+        ]
+        if not trees:
+            return None
+        return param_tree_bytes(trees)
+    except Exception:
+        return None
+
+
 def _scalar(analysis: Any, key: str) -> Optional[float]:
     """Pull one metric out of ``cost_analysis()`` output, whose container
     type changed across jax versions (dict vs [dict])."""
@@ -73,7 +102,7 @@ class CompileRecord:
     __slots__ = (
         "name", "aval_key", "flops", "bytes_accessed", "temp_bytes",
         "argument_bytes", "output_bytes", "hlo_fingerprint",
-        "compile_seconds",
+        "compile_seconds", "param_bytes",
     )
 
     def __init__(self, name: str, aval_key: str) -> None:
@@ -86,6 +115,9 @@ class CompileRecord:
         self.output_bytes: Optional[int] = None
         self.hlo_fingerprint: Optional[str] = None
         self.compile_seconds: float = 0.0
+        # Weight-quantized calls only: stored vs dequant-transient bytes
+        # of the argument param tree (ops.quant.param_tree_bytes).
+        self.param_bytes: Optional[Dict[str, int]] = None
 
     def as_dict(self) -> Dict[str, Any]:
         return {
@@ -98,6 +130,7 @@ class CompileRecord:
             "output_bytes": self.output_bytes,
             "hlo_fingerprint": self.hlo_fingerprint,
             "compile_seconds": round(self.compile_seconds, 6),
+            "param_bytes": self.param_bytes,
         }
 
 
@@ -164,6 +197,7 @@ class ProfiledFunction:
                       error=str(exc)[:200])
             return None
         rec = self._record(key, lowered, compiled, seconds)
+        rec.param_bytes = _wq_param_bytes(args, kwargs)
         prior = list(self.records)
         self.records[key] = rec
         tel.count("profiling.compiles")

@@ -168,6 +168,23 @@ def write_run_manifest(
     except Exception:
         pass
     try:
+        # Quantized-checkpoint cache hit/miss/stores/bytes-saved plus the
+        # most recent streaming load's peak-host-staging digest — same
+        # only-when-consulted posture as corpus_cache above.
+        from music_analyst_tpu.engines.checkpoint import last_load_stats
+        from music_analyst_tpu.engines.wq_cache import (
+            cache_stats as wq_stats,
+        )
+
+        stats = wq_stats()
+        load = last_load_stats()
+        if any(stats.values()) or load:
+            manifest["wq_cache"] = dict(stats)
+            if load:
+                manifest["wq_cache"]["last_load"] = load
+    except Exception:
+        pass
+    try:
         # Process-lifetime compile records (memoized engine callables
         # outlive a single run) — guarded so a jax-free manifest path or
         # a partial install never blocks the write.

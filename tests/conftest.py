@@ -23,6 +23,12 @@ import tempfile
 os.environ["MUSICAAL_CORPUS_CACHE"] = tempfile.mkdtemp(
     prefix="musicaal-test-corpus-cache-"
 )
+# Same hermeticity for the quantized-checkpoint cache (engines/wq_cache.py
+# defaults under ~/.cache): a per-session tmpdir keeps warm-hit assertions
+# deterministic and host state untouched.
+os.environ["MUSICAAL_WQ_CACHE"] = tempfile.mkdtemp(
+    prefix="musicaal-test-wq-cache-"
+)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -160,6 +160,42 @@ def test_lone_cr_dataset(tmp_path):
     assert_parity(n, p)
 
 
+def test_lone_cr_wordpiece_vocab(tmp_path):
+    # Thin native-layer twin of test_wordpiece_differential.py's
+    # universal-newline case: a classic-Mac (bare-\r) vocab must produce
+    # the same handle contents as the \n vocab — ingest.cpp's vocab
+    # parser treats \r, \r\n, and \n as one terminator.
+    from music_analyst_tpu.data.native import (
+        wp_create, wp_destroy, wp_encode_batch,
+    )
+    from music_analyst_tpu.models.tokenization import _wp_char_table
+
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+             "the", "rain", "love", "##s", "##ing"]
+    lf = tmp_path / "lf.txt"
+    lf.write_bytes("\n".join(vocab).encode() + b"\n")
+    cr = tmp_path / "cr.txt"
+    cr.write_bytes("\r".join(vocab).encode() + b"\r")
+    table = _wp_char_table()
+    h_lf = wp_create(str(lf), table)
+    h_cr = wp_create(str(cr), table)
+    assert h_lf and h_cr
+    try:
+        texts = ["the rains", "loves the rain"]
+        ids_lf, lens_lf, ok_lf = wp_encode_batch(h_lf, texts, 12)
+        ids_cr, lens_cr, ok_cr = wp_encode_batch(h_cr, texts, 12)
+        assert ok_lf.all() and ok_cr.all()
+        np.testing.assert_array_equal(ids_cr, ids_lf)
+        np.testing.assert_array_equal(lens_cr, lens_lf)
+        # A fused-lines regression would leave the CR vocab one entry
+        # short and shift ids; equality above catches it, this guards the
+        # test itself from an all-[UNK] vacuous pass.
+        assert ids_lf[:, 1].min() >= 5  # first content token is real
+    finally:
+        wp_destroy(h_lf)
+        wp_destroy(h_cr)
+
+
 def test_tsan_selftest(tmp_path):
     """Full threaded pipeline under ThreadSanitizer: any data race in the
     boundary-scan handoff or interner merge fails hard.  (The reference has

@@ -1,0 +1,412 @@
+"""Weight-only quantized parameter store (ops/quant.py QuantizedParam +
+engines/checkpoint.py streaming loader + engines/wq_cache.py).
+
+Covers the tentpole contract end to end on the CPU-emulated mesh:
+op-level error bounds for both schemes, streaming quantize-on-load with
+the O(one layer) peak-host-staging bound (the float tree never exists),
+cold→warm content-addressed cache round-trips with corruption injection,
+quantized-tree sharding under the 8-device dp×tp mesh, and small-config
+end-to-end label agreement vs the bf16 path.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from music_analyst_tpu.ops.quant import (
+    WQ_DEFAULT_GROUP,
+    QuantizedParam,
+    dequantize_param,
+    param_tree_bytes,
+    quantize_array,
+    quantize_tree,
+    wq_matmul,
+    wq_rule_for_path,
+)
+
+torch = pytest.importorskip("torch")
+
+from music_analyst_tpu.engines import wq_cache  # noqa: E402
+from music_analyst_tpu.engines.checkpoint import (  # noqa: E402
+    last_load_stats,
+    load_quantized_params,
+)
+from music_analyst_tpu.models.distilbert import (  # noqa: E402
+    DistilBertClassifier,
+    DistilBertConfig,
+    iter_hf_param_units,
+)
+from test_distilbert_checkpoint import _hf_state_dict  # noqa: E402
+
+
+# --------------------------------------------------------------------- ops
+
+
+class TestQuantOps:
+    def test_int8_roundtrip_error_bound(self):
+        w = np.random.RandomState(0).randn(64, 32).astype(np.float32)
+        qp = quantize_array(w, "int8")
+        assert isinstance(qp, QuantizedParam)
+        assert qp.q.dtype == jnp.int8 and qp.scale.shape == (1, 32)
+        back = np.asarray(dequantize_param(qp))
+        # Symmetric per-channel int8: error ≤ scale/2 per element.
+        bound = np.asarray(qp.scale)[0] / 2 + 1e-7
+        assert (np.abs(back - w) <= bound).all()
+
+    def test_int4_roundtrip_error_bound(self):
+        w = np.random.RandomState(1).randn(256, 16).astype(np.float32)
+        qp = quantize_array(w, "int4", group_size=128)
+        assert qp.q.shape == (128, 16)  # packed pairs along axis 0
+        back = np.asarray(dequantize_param(qp))
+        assert back.shape == w.shape
+        # Per-group scale = max|w|/7 → error ≤ scale/2.
+        rel = np.abs(back - w).max() / np.abs(w).max()
+        assert rel < 0.08, rel
+
+    def test_int4_odd_leading_axis_raises(self):
+        w = np.ones((7, 4), np.float32)
+        with pytest.raises(ValueError, match="even"):
+            quantize_array(w, "int4")
+
+    def test_unknown_scheme_raises(self):
+        with pytest.raises(ValueError, match="scheme"):
+            quantize_array(np.ones((4, 4), np.float32), "int2")
+
+    @pytest.mark.parametrize("scheme", ["int8", "int4"])
+    def test_wq_matmul_tracks_float(self, scheme):
+        rs = np.random.RandomState(2)
+        w = rs.randn(128, 64).astype(np.float32)
+        x = rs.randn(8, 128).astype(np.float32)
+        qp = quantize_array(w, scheme)
+        got = np.asarray(wq_matmul(jnp.asarray(x), qp))
+        want = x @ w
+        # Correlation, not mean-relative error: random-normal outputs
+        # cancel toward zero and inflate ratio metrics meaninglessly.
+        corr = np.corrcoef(got.ravel(), want.ravel())[0, 1]
+        assert corr > (0.999 if scheme == "int8" else 0.99), corr
+
+    def test_wq_dense_n_contract_2(self):
+        from music_analyst_tpu.ops.quant import wq_dense_axis_last2
+
+        rs = np.random.RandomState(3)
+        w = rs.randn(4, 16, 32).astype(np.float32)  # [heads, hd, out]
+        x = rs.randn(6, 4, 16).astype(np.float32)
+        qp = quantize_array(w, "int8", n_contract=2)
+        got = np.asarray(
+            wq_dense_axis_last2(jnp.asarray(x), qp, out_dtype=jnp.float32)
+        )
+        want = np.einsum("bhk,hko->bo", x, w)
+        corr = np.corrcoef(got.ravel(), want.ravel())[0, 1]
+        assert corr > 0.999, corr
+
+    def test_path_rules_select_projection_kernels_only(self):
+        assert wq_rule_for_path("layer_0/attention/q_proj/kernel") == 1
+        assert wq_rule_for_path("layer_3/attention/o_proj/kernel") == 2
+        assert wq_rule_for_path("layer_1/feed_forward/gate_proj/kernel") == 1
+        assert wq_rule_for_path("encoder/layer_0/ffn/lin1/kernel") == 1
+        assert wq_rule_for_path("lm_head/kernel") == 1
+        assert wq_rule_for_path("tok_embeddings/embedding") is None
+        assert wq_rule_for_path("layer_0/attention/q_proj/bias") is None
+        assert wq_rule_for_path("pre_classifier/kernel") is None
+
+    def test_quantized_tree_flows_through_jit(self):
+        w = np.random.RandomState(4).randn(32, 8).astype(np.float32)
+        tree = {"layer_0": {"attention": {"q_proj": {"kernel": w}}}}
+        qt = quantize_tree(tree, "int8")
+        qp = qt["layer_0"]["attention"]["q_proj"]["kernel"]
+        assert isinstance(qp, QuantizedParam)
+
+        @jax.jit
+        def f(t, x):
+            return wq_matmul(x, t["layer_0"]["attention"]["q_proj"]["kernel"])
+
+        out = f(qt, jnp.ones((2, 32)))
+        assert out.shape == (2, 8)
+        # Meta fields are static: a second call with the same structure
+        # must not retrace.
+        assert f._cache_size() == 1
+        f(qt, jnp.ones((2, 32)))
+        assert f._cache_size() == 1
+
+    def test_param_tree_bytes_accounting(self):
+        w = np.zeros((128, 64), np.float32)
+        tree = {
+            "layer_0": {"attention": {"q_proj": {"kernel": w}}},
+            "norm": {"scale": np.zeros((64,), np.float32)},
+        }
+        acc = param_tree_bytes(quantize_tree(tree, "int8"))
+        assert acc["n_quantized_leaves"] == 1
+        assert acc["n_float_leaves"] == 1
+        # codes (128·64·1) + scales (64·4) + float norm (64·4)
+        assert acc["stored_bytes"] == 128 * 64 + 64 * 4 + 64 * 4
+        assert acc["dequant_transient_bytes"] == 128 * 64 * 4
+
+
+# --------------------------------------------------- streaming load + cache
+
+
+@pytest.fixture()
+def ckpt(tmp_path):
+    cfg = DistilBertConfig.tiny()
+    path = tmp_path / "pytorch_model.bin"
+    torch.save(_hf_state_dict(cfg), path)
+    return cfg, str(path)
+
+
+def _params_shape(cfg, max_len=64):
+    from music_analyst_tpu.models.distilbert import DistilBertForSentiment
+
+    model = DistilBertForSentiment(cfg)
+    return jax.eval_shape(
+        model.init,
+        jax.random.key(0),
+        jnp.zeros((1, max_len), jnp.int32),
+        jnp.ones((1,), jnp.int32),
+    )["params"]
+
+
+class TestStreamingLoad:
+    def test_cold_then_warm_is_cache_hit(self, ckpt, tmp_path):
+        cfg, path = ckpt
+        cache_dir = str(tmp_path / "cache")
+        os.makedirs(cache_dir)
+        shape = _params_shape(cfg)
+        key = wq_cache.wq_key(path, "distilbert", "int8", WQ_DEFAULT_GROUP)
+
+        def load():
+            return load_quantized_params(
+                shape,
+                lambda: iter_hf_param_units(shape, path, mmap=True),
+                "int8",
+                cache_dir=cache_dir,
+                cache_key=key,
+            )
+
+        cold = load()
+        st = last_load_stats()
+        assert st["cache"] == "miss" and st["cache_stored"]
+        warm = load()
+        st = last_load_stats()
+        assert st["cache"] == "hit"
+        for a, b in zip(
+            jax.tree_util.tree_leaves(cold), jax.tree_util.tree_leaves(warm)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_peak_staging_is_one_unit(self, ckpt, tmp_path):
+        cfg, path = ckpt
+        shape = _params_shape(cfg)
+        load_quantized_params(
+            shape, lambda: iter_hf_param_units(shape, path), "int8"
+        )
+        st = last_load_stats()
+        # Units: embeddings, layer_0, layer_1, head.  The embeddings unit
+        # (vocab × dim + positions × dim) is the largest; the bound is
+        # peak ≤ (prefetch depth + 1) units, far below the full tree.
+        total_float = sum(
+            int(np.prod(l.shape)) * 4
+            for l in jax.tree_util.tree_leaves(shape)
+        )
+        assert st["units"] == cfg.n_layers + 2
+        assert 0 < st["peak_host_staging_bytes"] < total_float
+        assert st["cache"] == "off"
+
+    def test_loaded_tree_matches_eager_quantize(self, ckpt):
+        cfg, path = ckpt
+        from music_analyst_tpu.models.distilbert import (
+            load_hf_torch_checkpoint,
+        )
+
+        shape = _params_shape(cfg)
+        streamed = load_quantized_params(
+            shape, lambda: iter_hf_param_units(shape, path), "int8"
+        )
+        float_params = jax.tree_util.tree_map(
+            lambda l: np.zeros(l.shape, l.dtype), shape
+        )
+        float_params = load_hf_torch_checkpoint(float_params, path)
+        eager = quantize_tree(float_params, "int8", WQ_DEFAULT_GROUP)
+        sl = jax.tree_util.tree_leaves(streamed)
+        el = jax.tree_util.tree_leaves(eager)
+        assert len(sl) == len(el)
+        for a, b in zip(sl, el):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_truncated_npy_entry_evicted_and_reloaded(self, ckpt, tmp_path):
+        cfg, path = ckpt
+        cache_dir = str(tmp_path / "cache")
+        os.makedirs(cache_dir)
+        shape = _params_shape(cfg)
+        key = wq_cache.wq_key(path, "distilbert", "int8", WQ_DEFAULT_GROUP)
+
+        def load():
+            return load_quantized_params(
+                shape,
+                lambda: iter_hf_param_units(shape, path),
+                "int8",
+                cache_dir=cache_dir,
+                cache_key=key,
+            )
+
+        load()
+        entry = os.path.join(cache_dir, key)
+        victim = next(
+            os.path.join(entry, n)
+            for n in sorted(os.listdir(entry)) if n.endswith(".q.npy")
+        )
+        with open(victim, "r+b") as fh:
+            fh.truncate(16)  # torn mid-header
+        before = wq_cache.cache_stats()["corrupt"]
+        load()  # must not raise: corrupt → evict → miss → re-stream
+        st = last_load_stats()
+        assert st["cache"] == "miss"
+        assert wq_cache.cache_stats()["corrupt"] == before + 1
+        load()
+        assert last_load_stats()["cache"] == "hit"  # re-published
+
+    def test_stale_schema_key_misses(self, ckpt, tmp_path):
+        cfg, path = ckpt
+        cache_dir = str(tmp_path / "cache")
+        os.makedirs(cache_dir)
+        shape = _params_shape(cfg)
+        key = wq_cache.wq_key(path, "distilbert", "int8", WQ_DEFAULT_GROUP)
+        load_quantized_params(
+            shape, lambda: iter_hf_param_units(shape, path), "int8",
+            cache_dir=cache_dir, cache_key=key,
+        )
+        meta = os.path.join(cache_dir, key, "meta.json")
+        with open(meta, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        doc["schema"] = -1
+        with open(meta, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+        load_quantized_params(
+            shape, lambda: iter_hf_param_units(shape, path), "int8",
+            cache_dir=cache_dir, cache_key=key,
+        )
+        assert last_load_stats()["cache"] == "miss"
+
+    def test_different_scheme_is_different_key(self, ckpt):
+        _, path = ckpt
+        k8 = wq_cache.wq_key(path, "distilbert", "int8", WQ_DEFAULT_GROUP)
+        k4 = wq_cache.wq_key(path, "distilbert", "int4", WQ_DEFAULT_GROUP)
+        assert k8 != k4
+        # Content-addressed: a byte flip changes the key.
+        with open(path, "r+b") as fh:
+            fh.seek(100)
+            b = fh.read(1)
+            fh.seek(100)
+            fh.write(bytes([b[0] ^ 1]))
+        assert wq_cache.wq_key(
+            path, "distilbert", "int8", WQ_DEFAULT_GROUP
+        ) != k8
+
+
+# ----------------------------------------------------- mesh + end-to-end
+
+
+def _mesh(dp, tp):
+    devices = np.array(jax.devices()[: dp * tp]).reshape(dp, tp)
+    return Mesh(devices, ("dp", "tp"))
+
+
+class TestShardingAndEndToEnd:
+    def test_quantized_tree_shards_under_dp_tp(self, ckpt):
+        from music_analyst_tpu.parallel.sharding import (
+            partition_specs,
+            shard_params,
+        )
+
+        cfg, path = ckpt
+        shape = _params_shape(cfg)
+        tree = load_quantized_params(
+            shape, lambda: iter_hf_param_units(shape, path), "int8"
+        )
+        mesh = _mesh(4, 2)
+        specs = partition_specs(tree)
+        qspec = specs["encoder"]["layer_0"]["attention"]["q_proj"]["kernel"]
+        assert isinstance(qspec, QuantizedParam)
+        assert "tp" in tuple(qspec.q)
+        # Scales replicate over contraction axes only: feature axes keep
+        # the kernel's placement so the epilogue multiply never gathers.
+        assert tuple(qspec.scale)[0] is None
+        sharded = shard_params(tree, mesh)
+        qp = sharded["encoder"]["layer_0"]["attention"]["q_proj"]["kernel"]
+        assert isinstance(qp, QuantizedParam)
+        assert not qp.q.sharding.is_fully_replicated
+        del sharded
+
+    @pytest.mark.parametrize("scheme", ["int8", "int4"])
+    def test_label_agreement_vs_bf16(self, ckpt, scheme):
+        cfg, path = ckpt
+        texts = [
+            f"song {i}: love and rain over the lonely city " * (1 + i % 3)
+            for i in range(32)
+        ]
+        bf16 = DistilBertClassifier(
+            config=cfg, checkpoint_path=path, max_len=64, seed=0
+        )
+        want = bf16.classify_batch(texts)
+        wq = DistilBertClassifier(
+            config=dataclasses.replace(cfg, weight_quant=scheme),
+            checkpoint_path=path, max_len=64, seed=0, mesh=_mesh(4, 2),
+        )
+        st = last_load_stats()
+        assert st["scheme"] == scheme
+        got = wq.classify_batch(texts)
+        agree = sum(a == b for a, b in zip(want, got)) / len(texts)
+        assert agree >= 0.98, (agree, scheme)
+
+    def test_forward_donation_keeps_params_alive(self, ckpt):
+        # The batch args are donated; a quantized param tree must survive
+        # repeat classify calls (donating params would free the store).
+        cfg, path = ckpt
+        clf = DistilBertClassifier(
+            config=dataclasses.replace(cfg, weight_quant="int8"),
+            checkpoint_path=path, max_len=64, seed=0,
+        )
+        texts = ["love the rain", "hate the cold"] * 4
+        first = clf.classify_batch(texts)
+        second = clf.classify_batch(texts)
+        assert first == second
+
+    def test_weight_quant_excludes_dynamic_quant(self):
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            DistilBertConfig(quant="int8", weight_quant="int8")
+        with pytest.raises(ValueError, match="weight_quant"):
+            DistilBertConfig(weight_quant="fp8")
+
+    def test_get_backend_rejects_wq_for_mock(self):
+        from music_analyst_tpu.engines.sentiment import get_backend
+
+        with pytest.raises(ValueError, match="weight_quant"):
+            get_backend("mock", weight_quant="int8")
+
+    def test_manifest_records_wq_cache_section(self, ckpt, tmp_path):
+        cfg, path = ckpt
+        cache_dir = str(tmp_path / "cache")
+        os.makedirs(cache_dir)
+        shape = _params_shape(cfg)
+        key = wq_cache.wq_key(path, "distilbert", "int8", WQ_DEFAULT_GROUP)
+        for _ in range(2):  # miss then hit
+            load_quantized_params(
+                shape, lambda: iter_hf_param_units(shape, path), "int8",
+                cache_dir=cache_dir, cache_key=key,
+            )
+        from music_analyst_tpu.telemetry import get_telemetry
+
+        out = tmp_path / "run"
+        tel = get_telemetry()
+        with tel.run_scope("wq_manifest_test", str(out)):
+            pass
+        manifest_path = next(out.rglob("run_manifest.json"))
+        doc = json.loads(manifest_path.read_text())
+        assert doc["wq_cache"]["hits"] >= 1
+        assert doc["wq_cache"]["last_load"]["cache"] == "hit"

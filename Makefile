@@ -19,7 +19,7 @@ smoke:
 		$(PY) -m pytest tests/test_profiling.py tests/test_telemetry.py \
 		tests/test_telemetry_contract.py tests/test_runtime_pipeline.py \
 		tests/test_observability.py tests/test_corpus_cache.py \
-		tests/test_wq_store.py -q
+		tests/test_wq_store.py tests/test_serving.py -q
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= MUSICAAL_BENCH_SMOKE=1 \
 		$(PY) bench.py --baseline --attempts 1 --deadline 240 \
 		| $(PY) -c "import json,sys; \
@@ -68,6 +68,30 @@ print('smoke ok:', payload['metric'], payload['value'])"
 		"$$tmpdir/a.json" "$$tmpdir/b.json" || \
 		{ echo "telemetry-report self-check failed"; exit 1; }; \
 	echo "telemetry-report self-check ok"
+	# serving self-check: start the stdio server, send 3 requests, and
+	# assert the replies come back in order with the right ids AND that
+	# the run manifest grew a `serving` section (warm residency + batcher
+	# stats are a manifest contract, not just a wire one).
+	servetmp=$$(mktemp -d) && trap 'rm -rf "$$servetmp"' EXIT && \
+	printf '%s\n' \
+		'{"id":"s1","op":"sentiment","text":"I love this happy day"}' \
+		'{"id":"s2","op":"wordcount","text":"hello hello world"}' \
+		'{"id":"s3","op":"ping"}' | \
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+		$(PY) -m music_analyst_tpu serve --stdio --mock --quiet \
+		--max-batch 2 --max-wait-ms 2 --telemetry-dir "$$servetmp" \
+		> "$$servetmp/replies.ndjson" || { echo "serve run failed"; exit 1; }; \
+	$(PY) -c "import json,sys; \
+	lines=[json.loads(l) for l in open(sys.argv[1]) if l.strip()]; \
+	assert [r['id'] for r in lines]==['s1','s2','s3'], [r['id'] for r in lines]; \
+	assert all(r['ok'] for r in lines), lines; \
+	manifest=json.load(open(sys.argv[2])); \
+	serving=manifest['serving']; \
+	assert serving['requests']['completed']==2, serving['requests']; \
+	assert serving['residency']['warm'] is True, serving['residency']; \
+	print('serving self-check ok:', serving['requests']['batches'], 'batch(es)')" \
+		"$$servetmp/replies.ndjson" "$$servetmp/run_manifest.json" || \
+		{ echo "serving self-check failed"; exit 1; }
 
 test:
 	$(PY) -m pytest tests/ -q

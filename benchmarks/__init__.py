@@ -36,6 +36,7 @@ _SUITE_MODULES = (
     "benchmarks.overlap",
     "benchmarks.streaming",
     "benchmarks.wq_store",
+    "benchmarks.serving",
 )
 
 

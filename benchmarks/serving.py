@@ -1,0 +1,155 @@
+"""Serving layer: offered-load sweep × batch-size grid.
+
+Backs the "Serving latency" section in PERFORMANCE.md.  A warm mock
+backend (the keyword kernel — the serving overheads under test are
+host-side: admission, coalescing, padding, dispatch) is driven through
+the dynamic batcher at a grid of offered loads (burst sizes, as
+multiples of ``max_batch``) × ``max_batch`` settings.  Each cell reports
+throughput, batch occupancy, and p50/p95/p99 request latency from the
+batcher's own histogram.
+
+Two contract rows ride along:
+
+* **coalescing win** — at offered load ≥ ``max_batch``, the batcher's
+  throughput must beat sequential single-request dispatch (the
+  ``max_batch=1`` baseline) by ≥ 2× (the ISSUE 8 acceptance bar);
+* **overload shedding** — a burst 4× the admission bound must shed with
+  structured ``queue_full`` errors while every admitted request still
+  gets an answer and the server object survives.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from benchmarks import suite
+from benchmarks._util import device_info, smoke
+
+_LYRICS = (
+    "I love the sunshine and the happy days we share",
+    "darkness and sorrow follow me through the lonely night",
+    "la la la the radio plays our favourite song again",
+    "broken hearts mend slowly under winter skies",
+    "dancing together forever in the warm summer rain",
+)
+
+
+def _drive(ops, max_batch: int, n_requests: int,
+           max_wait_ms: float = 2.0, max_queue: int | None = None):
+    """Submit a burst of ``n_requests`` and wait for every reply."""
+    from music_analyst_tpu.serving.batcher import DynamicBatcher
+
+    batcher = DynamicBatcher(
+        ops, max_batch=max_batch, max_wait_ms=max_wait_ms,
+        max_queue=max_queue or (n_requests + 1),
+    ).start()
+    start = time.perf_counter()
+    reqs = [
+        batcher.submit(i, "sentiment", _LYRICS[i % len(_LYRICS)])
+        for i in range(n_requests)
+    ]
+    for req in reqs:
+        if not req.wait(timeout=120.0):
+            raise RuntimeError(f"request {req.id} never settled")
+    elapsed = time.perf_counter() - start
+    batcher.drain()
+    return elapsed, batcher.stats(), reqs
+
+
+@suite("serving")
+def run() -> dict:
+    from music_analyst_tpu.serving.residency import ModelResidency
+    from music_analyst_tpu.serving.server import build_ops
+
+    if smoke():
+        batch_grid, load_mults, n_base = (4, 8), (1, 4), 64
+    else:
+        batch_grid, load_mults, n_base = (8, 32, 64), (1, 4, 16), 2_048
+
+    residency = ModelResidency(model="mock", mock=True)
+    clf = residency.acquire()
+    warm = residency.warmup(max(batch_grid))
+    ops = build_ops(clf)
+
+    # Sequential baseline: same requests, one per batch — what the
+    # reference's call-per-song loop would do with a resident model.
+    n_seq = max(n_base // 4, max(batch_grid))
+    seq_s, seq_stats, _ = _drive(ops, max_batch=1, n_requests=n_seq)
+    seq_rps = n_seq / seq_s
+    print(f"[serving] sequential baseline: {seq_rps:.1f} req/s",
+          file=sys.stderr)
+
+    rows = []
+    best_coalesced = 0.0
+    for max_batch in batch_grid:
+        for mult in load_mults:
+            n = max(n_base, max_batch * mult)
+            elapsed, stats, _ = _drive(ops, max_batch=max_batch,
+                                       n_requests=n)
+            rps = n / elapsed
+            latency = stats["latency"]
+            offered = max_batch * mult
+            if offered >= max_batch:
+                best_coalesced = max(best_coalesced, rps)
+            print(
+                f"[serving] max_batch={max_batch} offered={offered} "
+                f"→ {rps:.1f} req/s, occupancy {stats['occupancy']}",
+                file=sys.stderr,
+            )
+            rows.append({
+                "max_batch": max_batch,
+                "offered_load": offered,
+                "requests": n,
+                "seconds": round(elapsed, 4),
+                "requests_per_s": round(rps, 2),
+                "batches": stats["batches"],
+                "occupancy": stats["occupancy"],
+                "p50_s": latency.get("p50_s"),
+                "p95_s": latency.get("p95_s"),
+                "p99_s": latency.get("p99_s"),
+            })
+
+    # Overload: burst far past the admission bound; the contract is
+    # structured shedding, full answers for the admitted, no crash.
+    over_batch = max(batch_grid)
+    over_queue = over_batch * 2
+    _, over_stats, over_reqs = _drive(
+        ops, max_batch=over_batch, n_requests=over_queue * 4,
+        max_queue=over_queue,
+    )
+    shed_kinds = {
+        r.response["error"]["kind"]
+        for r in over_reqs if not r.response.get("ok")
+    }
+    overload = {
+        "max_queue": over_queue,
+        "offered": over_queue * 4,
+        "admitted": over_stats["admitted"],
+        "shed": over_stats["shed"],
+        "completed": over_stats["completed"],
+        "shed_kinds": sorted(shed_kinds),
+        "all_answered": all(r.response is not None for r in over_reqs),
+    }
+    print(
+        f"[serving] overload: {overload['shed']} shed "
+        f"({overload['shed_kinds']}), {overload['completed']} completed",
+        file=sys.stderr,
+    )
+
+    return {
+        "suite": "serving",
+        **device_info(),
+        "smoke": smoke(),
+        "backend": getattr(clf, "name", "mock"),
+        "warmup": warm,
+        "sequential": {
+            "requests": n_seq,
+            "seconds": round(seq_s, 4),
+            "requests_per_s": round(seq_rps, 2),
+            "p50_s": seq_stats["latency"].get("p50_s"),
+        },
+        "rows": rows,
+        "coalescing_speedup": round(best_coalesced / seq_rps, 2),
+        "overload": overload,
+    }

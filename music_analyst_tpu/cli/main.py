@@ -8,9 +8,10 @@ with the same flags plus TPU-era additions (``--device``, ``--batch-size``):
 * ``wordcount-per-song`` ≙ ``scripts/word_count_per_song.py``
 * ``split``     ≙ ``scripts/split_csv_columns.py``
 
-TPU-era subcommands with no reference analogue: ``sweep`` (scaling
-sweeps), ``validate`` (weight certification), ``profile-diff`` (the
-perf-regression gate over run manifests / bench lines), and
+TPU-era subcommands with no reference analogue: ``serve`` (resident
+NDJSON inference server with dynamic batching, serving/), ``sweep``
+(scaling sweeps), ``validate`` (weight certification), ``profile-diff``
+(the perf-regression gate over run manifests / bench lines), and
 ``telemetry-report`` (cross-run analytics over telemetry dirs + bench
 captures).  Every run-scoped subcommand takes ``--profile-dir`` to
 capture device + span traces and ``--watchdog-timeout`` to arm the
@@ -274,6 +275,46 @@ def _add_telemetry_report(sub: argparse._SubParsersAction) -> None:
                         "instead of text")
 
 
+def _add_serve(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "serve",
+        help="resident inference server: newline-delimited JSON over a "
+             "unix socket (or --stdio), dynamic batching + warm model "
+             "residency (serving/)",
+    )
+    p.add_argument("--model", default="mock",
+                   help="Model family: mock, distilbert[-*], llama[3*]")
+    p.add_argument("--mock", action="store_true",
+                   help="Keyword-kernel backend (no model weights needed)")
+    p.add_argument("--weight-quant", choices=("none", "int8", "int4"),
+                   default="none",
+                   help="Serve the weight-quantized model (loads through "
+                        "the persistent $MUSICAAL_WQ_CACHE)")
+    p.add_argument("--socket", default=None, metavar="PATH",
+                   help="Unix socket path to listen on (loopback-only by "
+                        "construction)")
+    p.add_argument("--stdio", action="store_true",
+                   help="Serve one NDJSON stream on stdin/stdout instead "
+                        "of a socket (tests, pipelines)")
+    p.add_argument("--max-batch", type=int, default=None,
+                   help="Flush a batch at this many requests (default "
+                        f"$MUSICAAL_SERVE_MAX_BATCH or 32)")
+    p.add_argument("--max-wait-ms", type=float, default=None,
+                   help="Flush a partial batch once its oldest request "
+                        "has waited this long (default "
+                        "$MUSICAAL_SERVE_MAX_WAIT_MS or 5.0)")
+    p.add_argument("--max-queue", type=int, default=None,
+                   help="Admission queue bound; beyond it requests shed "
+                        "with a structured queue_full error (default "
+                        "$MUSICAAL_SERVE_MAX_QUEUE or 1024)")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="Skip the startup warmup batches (first request "
+                        "pays compile cost)")
+    p.add_argument("--quiet", action="store_true",
+                   help="Suppress stderr status lines")
+    _add_telemetry_flags(p)
+
+
 def _add_sweep(sub: argparse._SubParsersAction) -> None:
     p = sub.add_parser(
         "sweep",
@@ -298,6 +339,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_sentiment(sub)
     _add_wordcount_per_song(sub)
     _add_split(sub)
+    _add_serve(sub)
     _add_sweep(sub)
     _add_validate(sub)
     _add_profile_diff(sub)
@@ -486,6 +528,38 @@ def _dispatch(parser: argparse.ArgumentParser,
                 weight_quant=args.weight_quant,
             )
         return 0
+
+    if args.command == "serve":
+        from music_analyst_tpu.serving.server import run_server
+
+        if not args.stdio and not args.socket:
+            parser.error("serve requires --socket PATH or --stdio")
+        if args.weight_quant != "none" and (
+            args.mock or not (args.model.startswith("distilbert")
+                              or args.model.startswith("llama"))
+        ):
+            parser.error(
+                "--weight-quant requires an on-device model family "
+                "(distilbert[-*] or llama[3*])"
+            )
+        try:
+            return run_server(
+                model=args.model,
+                mock=args.mock,
+                weight_quant=(
+                    None if args.weight_quant == "none"
+                    else args.weight_quant
+                ),
+                stdio=args.stdio,
+                socket_path=args.socket,
+                max_batch=args.max_batch,
+                max_wait_ms=args.max_wait_ms,
+                max_queue=args.max_queue,
+                warmup=not args.no_warmup,
+                quiet=args.quiet,
+            )
+        except ValueError as exc:
+            parser.error(str(exc))
 
     if args.command == "wordcount-per-song":
         from music_analyst_tpu.engines.persong import run_per_song_wordcount

@@ -45,6 +45,7 @@ _ERROR_PATTERNS = (
         "stuck compiling",
     )),
     ("stage_stall", ("stage stall", "stage_stall")),
+    ("serve_stall", ("serve stall", "serve_stall", "serve.dispatch")),
     ("deadline_expired", ("deadline",)),
     ("harness_killed", ("killed by harness", "sigkill")),
 )
@@ -174,6 +175,19 @@ def _dir_record(directory: str, label: str) -> Optional[Dict[str, Any]]:
         trips = (obs.get("watchdog") or {}).get("trips") or []
         if trips:
             rec["trips"] = trips
+        # Histogram quantile summaries (p50/p95/p99) — serving latency
+        # first and foremost, but any quantile-bearing histogram shows.
+        quantiles: Dict[str, Dict[str, Any]] = {}
+        for name, hist in (manifest.get("histograms") or {}).items():
+            if isinstance(hist, dict) and hist.get("p50_s") is not None:
+                quantiles[name] = {
+                    k: hist.get(k) for k in ("p50_s", "p95_s", "p99_s")
+                }
+        if quantiles:
+            rec["latency_quantiles"] = quantiles
+        serving = manifest.get("serving")
+        if serving:
+            rec["serving"] = serving
     if os.path.exists(jsonl_path):
         found = True
         scan = _scan_jsonl(jsonl_path)
@@ -240,6 +254,7 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     trajectory: List[Dict[str, Any]] = []
     stalls: List[Dict[str, Any]] = []
     recompiles: Dict[str, int] = {}
+    latencies: List[Dict[str, Any]] = []
     for rec in records:
         if rec.get("error_kind"):
             taxonomy[rec["error_kind"]] = taxonomy.get(rec["error_kind"], 0) + 1
@@ -252,6 +267,14 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             })
         if rec.get("recompiles"):
             recompiles[rec["label"]] = rec["recompiles"]
+        for name, q in (rec.get("latency_quantiles") or {}).items():
+            latencies.append({
+                "label": rec["label"],
+                "name": name,
+                "p50_s": q.get("p50_s"),
+                "p95_s": q.get("p95_s"),
+                "p99_s": q.get("p99_s"),
+            })
         for name, pipe in (rec.get("pipeline") or {}).items():
             for stage in pipe.get("stages") or []:
                 if stage.get("stall_s") or stage.get("queue_depth_max"):
@@ -274,6 +297,7 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         ),
         "stalls": stalls,
         "recompiles": recompiles,
+        "latency_quantiles": latencies,
         "newest": {
             "label": newest["label"],
             "ok": newest["ok"],
@@ -313,6 +337,17 @@ def render_report(report: Dict[str, Any]) -> List[str]:
         lines.append("recompiles:")
         for label, n in report["recompiles"].items():
             lines.append(f"  {label}: {n}")
+    if report.get("latency_quantiles"):
+        lines.append("latency quantiles (p50/p95/p99 s):")
+        for q in report["latency_quantiles"]:
+            def _fmt(value: Any) -> str:
+                return (f"{value:.6f}"
+                        if isinstance(value, (int, float)) else "-")
+            lines.append(
+                f"  {q['label']} {q['name']}: "
+                f"{_fmt(q['p50_s'])} / {_fmt(q['p95_s'])} / "
+                f"{_fmt(q['p99_s'])}"
+            )
     newest = report.get("newest")
     if newest is not None:
         verdict = ("ok" if newest["ok"]

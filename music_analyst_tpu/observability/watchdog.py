@@ -15,6 +15,7 @@ compile   ``compile_hang``    ``profiling/compile.py`` lower+compile
 probe     ``tunnel_dead``     ``bench.py --probe`` device query
 device    ``device_stall``    engine collect()/step dispatch paths
 host      ``host_stall``      host-side loops (persong fold)
+serve     ``serve_stall``     ``serving/batcher.py`` dispatch edge
 ========  ==================  =====================================
 
 A trip emits a ``watchdog_trip`` telemetry event, records itself for the
@@ -50,6 +51,7 @@ TAXONOMY: Dict[str, str] = {
     "probe": "tunnel_dead",
     "device": "device_stall",
     "host": "host_stall",
+    "serve": "serve_stall",
 }
 
 

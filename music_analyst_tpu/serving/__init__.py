@@ -1,0 +1,52 @@
+"""Online serving layer: dynamic batching, admission control, residency.
+
+The batch engines answer "analyze this corpus"; this package answers
+"keep the model warm and answer requests as they arrive" — the
+production-inference shape the ROADMAP north star asks for:
+
+* :mod:`music_analyst_tpu.serving.batcher` — deadline-aware dynamic
+  batcher (flush on ``max_batch`` or ``max_wait_ms``) with bounded
+  admission queues that shed via structured ``queue_full`` errors;
+* :mod:`music_analyst_tpu.serving.residency` — load-once / warm-once
+  backend holder (weight-quant + persistent caches included);
+* :mod:`music_analyst_tpu.serving.server` — NDJSON protocol over a unix
+  socket or stdio, graceful SIGTERM drain, watchdog + flight-recorder
+  integration (the ``serve`` CLI subcommand).
+"""
+
+from music_analyst_tpu.serving.batcher import (
+    DEFAULT_MAX_BATCH,
+    DEFAULT_MAX_QUEUE,
+    DEFAULT_MAX_WAIT_MS,
+    DynamicBatcher,
+    ServeRequest,
+    resolve_max_batch,
+    resolve_max_queue,
+    resolve_max_wait_ms,
+)
+from music_analyst_tpu.serving.residency import ModelResidency, warmup_sizes
+from music_analyst_tpu.serving.server import (
+    PROTOCOL,
+    SentimentServer,
+    build_ops,
+    run_server,
+    serving_stats,
+)
+
+__all__ = [
+    "DEFAULT_MAX_BATCH",
+    "DEFAULT_MAX_QUEUE",
+    "DEFAULT_MAX_WAIT_MS",
+    "DynamicBatcher",
+    "ModelResidency",
+    "PROTOCOL",
+    "SentimentServer",
+    "ServeRequest",
+    "build_ops",
+    "resolve_max_batch",
+    "resolve_max_queue",
+    "resolve_max_wait_ms",
+    "run_server",
+    "serving_stats",
+    "warmup_sizes",
+]

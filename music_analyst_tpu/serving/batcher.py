@@ -1,0 +1,377 @@
+"""Deadline-aware dynamic batcher with bounded admission control.
+
+The serving analogue of the engines' batch loop: requests arrive one at
+a time (one NDJSON line each, ``serving/server.py``), but the device
+wants big, shape-stable batches.  This module coalesces queued requests
+into padded power-of-two bucket batches (``utils/shapes.round_pow2`` —
+the same rounding rule the engines compile under, so a warm server never
+meets a new shape), flushing a batch when it reaches ``max_batch`` OR
+when its oldest request has waited ``max_wait_ms`` — the classic
+latency/throughput dial (cf. TensorFlow Serving's dynamic batcher).
+
+Admission is *bounded*: a full queue sheds the request with a structured
+``queue_full`` error instead of blocking the reader — under overload the
+server stays responsive and the client learns to back off (the
+reference's one-HTTP-call-per-song loop simply falls behind forever).
+
+Fault isolation: a batch that raises is retried one request at a time,
+so a poison request fails alone (structured ``request_failed`` carrying
+its id) and its batchmates still get answers; the server never dies with
+the batch.
+
+Everything is mirrored into telemetry (``serving.*`` counters, queue
+depth / occupancy gauges, latency histograms with p50/p95/p99) and into
+a local stats dict the run manifest's ``serving`` section snapshots.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from music_analyst_tpu.observability import watchdog
+from music_analyst_tpu.telemetry import get_telemetry
+from music_analyst_tpu.telemetry.core import Histogram
+from music_analyst_tpu.utils.shapes import round_pow2
+
+# Flag defaults; $MUSICAAL_SERVE_* overrides, explicit flags win
+# (the watchdog-timeout resolution pattern).
+DEFAULT_MAX_BATCH = 32
+DEFAULT_MAX_WAIT_MS = 5.0
+DEFAULT_MAX_QUEUE = 1024
+
+# Occupancy lives in (0, 1]; the latency-shaped default buckets would
+# put every observation in one bin.
+_OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+# Request-latency buckets: sub-ms host ops up to multi-second cold paths.
+_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0, 30.0,
+)
+
+
+def _resolve(value: Any, env: str, default: float, *, integer: bool,
+             minimum: float) -> float:
+    """Explicit value wins and raises on malformed input (usage error);
+    a malformed env var falls back to the default — serving config must
+    never crash the server before it can answer a request."""
+    if value is None:
+        raw = os.environ.get(env, "").strip()
+        if not raw:
+            return default
+        try:
+            parsed = float(raw)
+        except ValueError:
+            return default
+        if not math.isfinite(parsed) or parsed < minimum:
+            return default
+        return int(parsed) if integer else parsed
+    try:
+        parsed = float(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"expected a number >= {minimum}, got {value!r}"
+        ) from None
+    if not math.isfinite(parsed) or parsed < minimum:
+        raise ValueError(f"expected a number >= {minimum}, got {value!r}")
+    return int(parsed) if integer else parsed
+
+
+def resolve_max_batch(value: Any = None) -> int:
+    return int(_resolve(value, "MUSICAAL_SERVE_MAX_BATCH",
+                        DEFAULT_MAX_BATCH, integer=True, minimum=1))
+
+
+def resolve_max_wait_ms(value: Any = None) -> float:
+    return _resolve(value, "MUSICAAL_SERVE_MAX_WAIT_MS",
+                    DEFAULT_MAX_WAIT_MS, integer=False, minimum=0.0)
+
+
+def resolve_max_queue(value: Any = None) -> int:
+    return int(_resolve(value, "MUSICAAL_SERVE_MAX_QUEUE",
+                        DEFAULT_MAX_QUEUE, integer=True, minimum=1))
+
+
+class ServeRequest:
+    """One admitted (or immediately shed) request and its settled reply.
+
+    The reply dict is the wire payload minus nothing — the server writes
+    ``response`` verbatim as one NDJSON line, so ordering/identity live
+    entirely in the ``id`` the client supplied.
+    """
+
+    __slots__ = ("id", "op", "text", "t_enqueue", "_done", "response")
+
+    def __init__(self, rid: Any, op: str, text: str) -> None:
+        self.id = rid
+        self.op = op
+        self.text = text
+        self.t_enqueue = time.monotonic()
+        self._done = threading.Event()
+        self.response: Optional[Dict[str, Any]] = None
+
+    def complete(self, payload: Dict[str, Any]) -> None:
+        self.response = payload
+        self._done.set()
+
+    def succeed(self, **fields: Any) -> None:
+        out: Dict[str, Any] = {"id": self.id, "ok": True, "op": self.op}
+        out.update(fields)
+        self.complete(out)
+
+    def fail(self, kind: str, detail: str = "") -> None:
+        self.complete({
+            "id": self.id,
+            "ok": False,
+            "op": self.op,
+            "error": {"kind": kind, "detail": detail},
+        })
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+
+class DynamicBatcher:
+    """Coalesce queued requests into padded power-of-two batches.
+
+    ``ops`` maps an op name to a batch function: ``fn(texts) -> [payload
+    dict per row]`` (e.g. ``{"label": "Positive"}``).  Padding rows are
+    empty strings — safe for every backend (empty lyric → Neutral is a
+    golden contract) — and their results are discarded.
+    """
+
+    def __init__(
+        self,
+        ops: Dict[str, Callable[[List[str]], List[Dict[str, Any]]]],
+        max_batch: Optional[int] = None,
+        max_wait_ms: Optional[float] = None,
+        max_queue: Optional[int] = None,
+        name: str = "serve",
+    ) -> None:
+        self._ops = dict(ops)
+        self.max_batch = resolve_max_batch(max_batch)
+        self.max_wait_ms = resolve_max_wait_ms(max_wait_ms)
+        self.max_queue = resolve_max_queue(max_queue)
+        self.name = name
+        self._queues: Dict[str, deque] = {op: deque() for op in self._ops}
+        self._cond = threading.Condition()
+        self._draining = False
+        self._thread: Optional[threading.Thread] = None
+        self._latency = Histogram(_LATENCY_BUCKETS)
+        self._occupancy = Histogram(_OCCUPANCY_BUCKETS)
+        self._stats_lock = threading.Lock()
+        self._stats: Dict[str, int] = {
+            "admitted": 0, "shed": 0, "completed": 0, "failed": 0,
+            "bad_request": 0, "batches": 0, "rows": 0, "padded_rows": 0,
+            "queue_depth_max": 0, "isolation_retries": 0,
+        }
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "DynamicBatcher":
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name=f"{self.name}-batcher", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def drain(self, timeout: Optional[float] = 30.0) -> None:
+        """Stop admitting, flush every queued request, stop the worker.
+
+        Queued requests are *answered* (processed, or failed with a
+        structured error if the backend breaks) — never dropped silently;
+        the graceful-SIGTERM contract rides on this.
+        """
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=timeout)
+        self._thread = None
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # ----------------------------------------------------------- admission
+
+    def submit(self, rid: Any, op: str, text: str) -> ServeRequest:
+        """Admit (or shed) one request; always returns a ServeRequest —
+        a shed one is already completed with its structured error."""
+        tel = get_telemetry()
+        req = ServeRequest(rid, op, text)
+        if op not in self._ops:
+            req.fail(
+                "bad_request",
+                f"unknown op {op!r}; have: {sorted(self._ops)}",
+            )
+            self._bump(bad_request=1)
+            return req
+        with self._cond:
+            if self._draining:
+                req.fail("draining", "server is draining; not admitting")
+                self._bump(shed=1)
+                tel.count("serving.shed")
+                return req
+            depth = sum(len(q) for q in self._queues.values())
+            if depth >= self.max_queue:
+                req.fail(
+                    "queue_full",
+                    f"admission queue full ({depth}/{self.max_queue}); "
+                    "retry with backoff",
+                )
+                self._bump(shed=1)
+                tel.count("serving.shed")
+                return req
+            self._queues[op].append(req)
+            depth += 1
+            self._cond.notify_all()
+        with self._stats_lock:
+            self._stats["admitted"] += 1
+            if depth > self._stats["queue_depth_max"]:
+                self._stats["queue_depth_max"] = depth
+        tel.count("serving.admitted")
+        tel.gauge("serving.queue_depth", depth)
+        return req
+
+    def _bump(self, **deltas: int) -> None:
+        with self._stats_lock:
+            for key, n in deltas.items():
+                self._stats[key] += n
+
+    # -------------------------------------------------------------- worker
+
+    def _oldest_op(self) -> Optional[str]:
+        """Op whose head request has waited longest (caller holds cond)."""
+        best: Optional[Tuple[float, str]] = None
+        for op, q in self._queues.items():
+            if q and (best is None or q[0].t_enqueue < best[0]):
+                best = (q[0].t_enqueue, op)
+        return best[1] if best else None
+
+    def _next_batch(self) -> Tuple[Optional[str], List[ServeRequest]]:
+        """Block until a batch is due (full, deadline hit, or draining);
+        ``(None, [])`` means drained-and-empty: the worker exits."""
+        with self._cond:
+            while True:
+                op = self._oldest_op()
+                if op is None:
+                    if self._draining:
+                        return None, []
+                    self._cond.wait(0.05)
+                    continue
+                q = self._queues[op]
+                waited_ms = (time.monotonic() - q[0].t_enqueue) * 1000.0
+                if (len(q) >= self.max_batch or self._draining
+                        or waited_ms >= self.max_wait_ms):
+                    batch = [
+                        q.popleft()
+                        for _ in range(min(len(q), self.max_batch))
+                    ]
+                    return op, batch
+                remaining_s = (self.max_wait_ms - waited_ms) / 1000.0
+                self._cond.wait(min(max(remaining_s, 0.001), 0.05))
+
+    def _loop(self) -> None:
+        tel = get_telemetry()
+        while True:
+            op, batch = self._next_batch()
+            if op is None:
+                return
+            self._dispatch(op, batch)
+            tel.gauge(
+                "serving.queue_depth",
+                sum(len(q) for q in self._queues.values()),
+            )
+            watchdog.beat("serve.dispatch")
+
+    def _dispatch(self, op: str, batch: List[ServeRequest]) -> None:
+        tel = get_telemetry()
+        n = len(batch)
+        padded = round_pow2(n, 1)
+        texts = [r.text for r in batch] + [""] * (padded - n)
+        t0 = time.perf_counter()
+        try:
+            # The dispatch edge is where a wedged device/tunnel would hang
+            # a resident server silently — the watchdog classifies that as
+            # serve_stall instead of a mute socket.
+            with watchdog.watch("serve.dispatch", kind="serve"):
+                with tel.span("serve.batch", op=op, rows=n, padded=padded):
+                    results = self._ops[op](texts)[:n]
+            if len(results) != n:
+                raise RuntimeError(
+                    f"op {op!r} returned {len(results)} results for "
+                    f"{n} rows"
+                )
+        except Exception as exc:  # noqa: BLE001 — isolation boundary
+            if n == 1:
+                batch[0].fail(
+                    "request_failed",
+                    f"{type(exc).__name__}: {exc}"[:300],
+                )
+                self._bump(failed=1)
+                tel.count("serving.request_failed")
+                return
+            # Retry one-by-one: the poison request fails alone, its
+            # batchmates still get answers.
+            self._bump(isolation_retries=1)
+            tel.count("serving.isolation_retries")
+            for req in batch:
+                self._dispatch(op, [req])
+            return
+        batch_s = time.perf_counter() - t0
+        tel.observe("serving.batch_seconds", batch_s)
+        occupancy = n / padded
+        now = time.monotonic()
+        with self._stats_lock:
+            self._stats["batches"] += 1
+            self._stats["rows"] += n
+            self._stats["padded_rows"] += padded
+            self._stats["completed"] += n
+            self._occupancy.observe(occupancy)
+            for req in batch:
+                self._latency.observe(now - req.t_enqueue)
+        tel.observe(
+            "serving.batch_occupancy", occupancy,
+            buckets=_OCCUPANCY_BUCKETS,
+        )
+        for req, payload in zip(batch, results):
+            tel.observe(
+                "serving.request_seconds", now - req.t_enqueue,
+                buckets=_LATENCY_BUCKETS,
+            )
+            req.succeed(**payload)
+        tel.count("serving.completed", n)
+
+    # ------------------------------------------------------------ readouts
+
+    def stats(self) -> Dict[str, Any]:
+        """JSON-able snapshot: admission counters, batch shape economics,
+        and request-latency quantiles (the manifest ``serving`` section
+        and the serving bench suite both read this)."""
+        with self._stats_lock:
+            out: Dict[str, Any] = dict(self._stats)
+            occupancy = (
+                out["rows"] / out["padded_rows"] if out["padded_rows"] else None
+            )
+            latency = self._latency.as_dict()
+            occ = self._occupancy.as_dict()
+        out.update(
+            max_batch=self.max_batch,
+            max_wait_ms=self.max_wait_ms,
+            max_queue=self.max_queue,
+            occupancy=round(occupancy, 4) if occupancy is not None else None,
+            latency=latency,
+            batch_occupancy_hist=occ,
+        )
+        return out

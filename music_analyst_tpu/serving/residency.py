@@ -1,0 +1,159 @@
+"""Warm model residency: load once, compile once, answer forever.
+
+The batch engines pay model load + XLA compile on every invocation and
+amortize it over a whole dataset; a server amortizes it over its
+*lifetime* instead.  This manager owns that lifetime:
+
+* **load once** — the backend resolves through the same
+  ``engines/sentiment.get_backend`` dispatch the CLI uses, so
+  ``--weight-quant`` streams the checkpoint through
+  ``engines/checkpoint.load_quantized_params`` + the persistent
+  ``wq_cache`` exactly like a batch run, and the persistent XLA
+  compilation cache is enabled before the first compile;
+* **pin for the server lifetime** — the classifier (and its on-device
+  params) is held by this object until :meth:`release`; nothing about
+  the request path can drop it;
+* **warm explicitly** — :meth:`warmup` runs one dummy batch at every
+  power-of-two bucket size the batcher can emit, so by the time the
+  socket opens every steady-state shape is compiled and the first real
+  request pays dispatch cost only (``--warmup``, default on).
+
+Per-backend compile/warmup state is tracked in :meth:`snapshot` and
+lands in the run manifest's ``serving.residency`` section.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from music_analyst_tpu.telemetry import get_telemetry
+
+
+def warmup_sizes(max_batch: int) -> List[int]:
+    """The power-of-two bucket ladder the batcher pads into: 1, 2, 4, …
+    up to (and including) the bucket covering ``max_batch``."""
+    sizes: List[int] = []
+    size = 1
+    while size < max_batch:
+        sizes.append(size)
+        size <<= 1
+    sizes.append(size)
+    return sizes
+
+
+class ModelResidency:
+    """Load-once, warm-once holder for a classifier backend."""
+
+    def __init__(
+        self,
+        model: str = "mock",
+        mock: bool = False,
+        weight_quant: Optional[str] = None,
+        mesh=None,
+        backend=None,
+    ) -> None:
+        self.model = model
+        self.mock = mock
+        self.weight_quant = weight_quant
+        self.mesh = mesh
+        self._backend = backend  # injected (tests) — skips loading
+        self._lock = threading.Lock()
+        self._state: Dict[str, Any] = {
+            "model": model,
+            "mock": bool(mock),
+            "weight_quant": weight_quant or "none",
+            "loaded": backend is not None,
+            "load_seconds": 0.0,
+            "warm": False,
+            "warmup": None,
+        }
+
+    # ------------------------------------------------------------- loading
+
+    def acquire(self):
+        """The resident backend, loading it on first call (thread-safe)."""
+        with self._lock:
+            if self._backend is not None:
+                return self._backend
+            tel = get_telemetry()
+            from music_analyst_tpu.engines.sentiment import get_backend
+            from music_analyst_tpu.utils.cache import (
+                enable_persistent_compilation_cache,
+            )
+
+            enable_persistent_compilation_cache()
+            t0 = time.perf_counter()
+            with tel.span("serve.load", model=self.model,
+                          weight_quant=self.weight_quant or "none"):
+                self._backend = get_backend(
+                    self.model,
+                    mock=self.mock,
+                    mesh=self.mesh,
+                    weight_quant=self.weight_quant,
+                )
+            load_s = time.perf_counter() - t0
+            self._state.update(
+                loaded=True,
+                backend=getattr(self._backend, "name", "injected"),
+                load_seconds=round(load_s, 6),
+            )
+            # Streaming weight-quant loads leave per-unit staging stats;
+            # surface them next to the residency record when present.
+            try:
+                from music_analyst_tpu.engines.checkpoint import (
+                    last_load_stats,
+                )
+
+                load_stats = last_load_stats()
+                if load_stats:
+                    self._state["wq_load"] = load_stats
+            except Exception:
+                pass
+            return self._backend
+
+    # ------------------------------------------------------------- warmup
+
+    def warmup(self, max_batch: int) -> Dict[str, Any]:
+        """Compile every batcher bucket shape before the first request.
+
+        Dummy rows are empty strings (empty lyric → Neutral is a golden
+        contract, so this is semantically inert for every backend).
+        Returns and records {sizes, seconds, compiles} where ``compiles``
+        is the XLA compile count the warmup itself triggered.
+        """
+        clf = self.acquire()
+        tel = get_telemetry()
+        sizes = warmup_sizes(max_batch)
+        before = tel.compile_stats()
+        t0 = time.perf_counter()
+        with tel.span("serve.warmup", sizes=sizes):
+            for size in sizes:
+                clf.collect(clf.submit([""] * size))
+        warm_s = time.perf_counter() - t0
+        after = tel.compile_stats()
+        record = {
+            "sizes": sizes,
+            "seconds": round(warm_s, 6),
+            "compiles": after["count"] - before["count"],
+            "compile_seconds": round(
+                after["seconds"] - before["seconds"], 6
+            ),
+        }
+        with self._lock:
+            self._state["warm"] = True
+            self._state["warmup"] = record
+        tel.annotate(serve_warmup=record)
+        return record
+
+    def release(self) -> None:
+        with self._lock:
+            self._backend = None
+            self._state["loaded"] = False
+
+    # ------------------------------------------------------------ readouts
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._state)

@@ -1,0 +1,444 @@
+"""Resident inference server: newline-delimited JSON over a unix socket.
+
+The reference's sentiment path is one process per invocation; this is
+the shape of a production stack instead — a process that loads the model
+once (``serving/residency.py``), keeps it warm, and answers requests as
+they arrive through the dynamic batcher (``serving/batcher.py``).
+
+**Protocol** (``ndjson/v1``, loopback-only by construction — a unix
+socket or the process's own stdio; nothing here can reach a network):
+
+* request: ``{"id": <any>, "op": "sentiment"|"wordcount", "text": ...}``
+  (``op`` defaults to ``sentiment``; a missing ``id`` gets an
+  ``auto-<n>`` one).  Control ops: ``ping``, ``stats``, ``shutdown``.
+* response: one JSON line per request, **in request arrival order per
+  connection**: ``{"id":…, "ok": true, "op":…, …payload}`` or
+  ``{"id":…, "ok": false, "error": {"kind":…, "detail":…}}``.
+  Structured error kinds: ``queue_full`` (admission shed — retry with
+  backoff), ``bad_request``, ``request_failed`` (that request's model
+  row raised; the server lives on), ``draining``.
+
+**Graceful drain**: SIGTERM/SIGINT (or the ``shutdown`` op, or stdin
+EOF in ``--stdio`` mode) stops admission, finishes every in-flight and
+queued batch, writes the remaining replies, dumps a flight record
+(``observability/flight.py``) so the drain is a diagnosable artifact,
+and exits 0.  The heartbeat watchdog covers the dispatch edge with the
+``serve`` kind (taxonomy ``serve_stall``), and per-request spans +
+queue-depth/occupancy gauges flow through telemetry into the run
+manifest's ``serving`` section.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import queue
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from music_analyst_tpu.serving.batcher import (
+    DynamicBatcher,
+    ServeRequest,
+    resolve_max_batch,
+    resolve_max_queue,
+    resolve_max_wait_ms,
+)
+from music_analyst_tpu.serving.residency import ModelResidency
+from music_analyst_tpu.telemetry import get_telemetry
+
+PROTOCOL = "ndjson/v1"
+
+_EOF = object()  # reader→writer sentinel: the stream ended
+
+# The live server (for the run manifest's ``serving`` section — the
+# pattern corpus_cache/wq_cache established: stats only exist once the
+# subsystem has been used, so serve-free runs keep their key set).
+_LAST_SERVER: Optional["SentimentServer"] = None
+
+
+def serving_stats() -> Dict[str, Any]:
+    """Stats of the most recent server in this process ({} if none)."""
+    server = _LAST_SERVER
+    return server.stats_snapshot() if server is not None else {}
+
+
+def _wordcount_batch(texts: List[str]) -> List[Dict[str, Any]]:
+    """Per-request word counts with the serial per-song tool's tokenizer
+    semantics (``data/tokenizer.tokenize_latin1``) and the golden ranking
+    (count desc, then strcmp asc)."""
+    from music_analyst_tpu.data.tokenizer import tokenize_latin1
+
+    out: List[Dict[str, Any]] = []
+    for text in texts:
+        counts = collections.Counter(tokenize_latin1(text))
+        ranked = dict(
+            sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+        )
+        out.append({
+            "counts": ranked,
+            "total_words": int(sum(counts.values())),
+        })
+    return out
+
+
+def build_ops(clf) -> Dict[str, Any]:
+    """The batcher op table for a resident classifier backend."""
+    def sentiment(texts: List[str]) -> List[Dict[str, Any]]:
+        return [{"label": label} for label in clf.classify_batch(texts)]
+
+    return {"sentiment": sentiment, "wordcount": _wordcount_batch}
+
+
+class SentimentServer:
+    """Wire protocol + connection lifecycle around a DynamicBatcher."""
+
+    def __init__(
+        self,
+        batcher: DynamicBatcher,
+        residency: Optional[ModelResidency] = None,
+        mode: str = "stdio",
+    ) -> None:
+        self.batcher = batcher
+        self.residency = residency
+        self.mode = mode
+        self.drain_event = threading.Event()
+        self.drain_reason: Optional[str] = None
+        self._drain_lock = threading.Lock()
+        self._drained = False
+        self._auto_ids = 0
+        self._started_mono = time.monotonic()
+        global _LAST_SERVER
+        _LAST_SERVER = self
+
+    # ------------------------------------------------------------- control
+
+    def request_drain(self, reason: str, record: bool = True) -> None:
+        """Begin a graceful drain (idempotent): stop admission, flush the
+        queues, and (for signals/shutdown — not a routine stdio EOF) leave
+        a flight record naming the reason."""
+        if self.drain_event.is_set():
+            return
+        self.drain_reason = reason
+        self.drain_event.set()
+        tel = get_telemetry()
+        tel.event("serve_drain", reason=reason)
+        if not record:
+            return
+        try:
+            from music_analyst_tpu.observability.flight import (
+                get_flight_recorder,
+            )
+
+            get_flight_recorder().dump(
+                reason=f"serve_drain:{reason}",
+                detail=(
+                    f"graceful drain ({reason}); queued requests flushed, "
+                    "admission closed"
+                ),
+            )
+        except Exception:
+            pass
+
+    def _drain_batcher(self) -> None:
+        with self._drain_lock:
+            if not self._drained:
+                self.batcher.drain()
+                self._drained = True
+
+    # ------------------------------------------------------------ protocol
+
+    def _control(self, rid: Any, op: str) -> Dict[str, Any]:
+        if op == "ping":
+            return {"id": rid, "ok": True, "op": "ping",
+                    "protocol": PROTOCOL}
+        if op == "stats":
+            return {"id": rid, "ok": True, "op": "stats",
+                    "stats": self.stats_snapshot()}
+        # shutdown: the reply goes out first (in order), then the stream
+        # loop sees drain_event and flushes the rest.
+        self.request_drain("shutdown_op")
+        return {"id": rid, "ok": True, "op": "shutdown", "draining": True}
+
+    def _parse_submit(self, line: str) -> ServeRequest:
+        """One wire line → an admitted/settled ServeRequest (parse errors
+        settle immediately as ``bad_request`` so ordering still holds)."""
+        self._auto_ids += 1
+        fallback_id = f"auto-{self._auto_ids}"
+        try:
+            payload = json.loads(line)
+            if not isinstance(payload, dict):
+                raise ValueError("request must be a JSON object")
+        except ValueError as exc:
+            req = ServeRequest(fallback_id, "invalid", "")
+            req.fail("bad_request", f"unparseable request: {exc}"[:200])
+            return req
+        rid = payload.get("id", fallback_id)
+        op = payload.get("op", "sentiment")
+        if op in ("ping", "stats", "shutdown"):
+            req = ServeRequest(rid, op, "")
+            req.complete(self._control(rid, op))
+            return req
+        text = payload.get("text")
+        if not isinstance(text, str):
+            req = ServeRequest(rid, op, "")
+            req.fail("bad_request", "missing/non-string 'text' field")
+            return req
+        return self.batcher.submit(rid, op, text)
+
+    # ---------------------------------------------------------- stream I/O
+
+    def handle_stream(self, rfile, wfile, drain_on_eof: bool = False) -> int:
+        """Serve one NDJSON stream: replies in request arrival order.
+
+        A reader thread admits requests as fast as the peer sends them
+        (so a whole burst coalesces); this thread writes each settled
+        reply in order.  Returns the number of replies written.
+        """
+        tel = get_telemetry()
+        order: "queue.Queue" = queue.Queue()
+        stop_reading = threading.Event()
+
+        def read_loop() -> None:
+            try:
+                for line in rfile:
+                    if stop_reading.is_set() or self.drain_event.is_set():
+                        break
+                    line = line.strip()
+                    if not line:
+                        continue
+                    order.put(self._parse_submit(line))
+            except (OSError, ValueError):
+                pass  # peer vanished mid-line: the writer flushes and exits
+            finally:
+                order.put(_EOF)
+
+        reader = threading.Thread(
+            target=read_loop, name="serve-reader", daemon=True
+        )
+        reader.start()
+
+        written = 0
+        eof = False
+        while True:
+            if self.drain_event.is_set():
+                # Admission is closed; everything already queued settles
+                # once the batcher finishes its flush.
+                self._drain_batcher()
+            try:
+                item = order.get(timeout=0.05)
+            except queue.Empty:
+                if eof or (self.drain_event.is_set() and order.empty()):
+                    break
+                continue
+            if item is _EOF:
+                eof = True
+                if drain_on_eof:
+                    self.request_drain("eof", record=False)
+                    self._drain_batcher()
+                if order.empty():
+                    break
+                continue
+            req: ServeRequest = item
+            # Bounded waits so a drain can't strand the writer; the
+            # batcher answers every admitted request on drain.
+            while not req.wait(timeout=0.2):
+                if self.drain_event.is_set():
+                    self._drain_batcher()
+            with tel.span("serve.reply", op=req.op):
+                wfile.write(json.dumps(req.response) + "\n")
+                wfile.flush()
+            written += 1
+        stop_reading.set()
+        return written
+
+    # ------------------------------------------------------------- sockets
+
+    def serve_unix(self, path: str) -> int:
+        """Accept loop on a unix stream socket (thread per connection);
+        returns the number of connections served after a drain."""
+        import os
+        import socket
+
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.bind(path)
+        sock.listen(16)
+        sock.settimeout(0.2)
+        conns: List[threading.Thread] = []
+        served = 0
+        try:
+            while not self.drain_event.is_set():
+                try:
+                    conn, _ = sock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                served += 1
+
+                def _one(conn=conn) -> None:
+                    with conn:
+                        rfile = conn.makefile("r", encoding="utf-8")
+                        wfile = conn.makefile("w", encoding="utf-8")
+                        try:
+                            self.handle_stream(rfile, wfile)
+                        except (OSError, ValueError):
+                            pass
+
+                thread = threading.Thread(
+                    target=_one, name=f"serve-conn-{served}", daemon=True
+                )
+                thread.start()
+                conns.append(thread)
+        finally:
+            self._drain_batcher()
+            for thread in conns:
+                thread.join(timeout=5.0)
+            sock.close()
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        return served
+
+    # ------------------------------------------------------------ readouts
+
+    def stats_snapshot(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "protocol": PROTOCOL,
+            "mode": self.mode,
+            "uptime_s": round(time.monotonic() - self._started_mono, 3),
+            "draining": self.drain_event.is_set(),
+            "drain_reason": self.drain_reason,
+            "requests": self.batcher.stats(),
+        }
+        if self.residency is not None:
+            out["residency"] = self.residency.snapshot()
+        return out
+
+
+# ----------------------------------------------------------------- CLI glue
+
+
+def run_server(
+    model: str = "mock",
+    mock: bool = False,
+    weight_quant: Optional[str] = None,
+    stdio: bool = False,
+    socket_path: Optional[str] = None,
+    max_batch: Optional[int] = None,
+    max_wait_ms: Optional[float] = None,
+    max_queue: Optional[int] = None,
+    warmup: bool = True,
+    backend=None,
+    quiet: bool = False,
+) -> int:
+    """The ``serve`` subcommand: load, warm, then serve until drained.
+
+    Startup chatter goes to stderr only — in ``--stdio`` mode stdout *is*
+    the reply channel and must carry nothing but NDJSON responses.
+    """
+    tel = get_telemetry()
+    resolved_batch = resolve_max_batch(max_batch)
+    with tel.run_scope("serve", None):
+        residency = ModelResidency(
+            model=model, mock=mock, weight_quant=weight_quant,
+            backend=backend,
+        )
+        clf = residency.acquire()
+        if warmup:
+            record = residency.warmup(resolved_batch)
+            if not quiet:
+                print(
+                    f"serve: warmed {len(record['sizes'])} bucket shape(s) "
+                    f"in {record['seconds']:.2f}s "
+                    f"({record['compiles']} compile(s))",
+                    file=sys.stderr,
+                )
+        batcher = DynamicBatcher(
+            build_ops(clf),
+            max_batch=resolved_batch,
+            max_wait_ms=max_wait_ms,
+            max_queue=max_queue,
+        ).start()
+        server = SentimentServer(
+            batcher, residency, mode="stdio" if stdio else "unix"
+        )
+        tel.annotate(
+            backend=getattr(clf, "name", "injected"),
+            serve_mode=server.mode,
+            max_batch=batcher.max_batch,
+            max_wait_ms=batcher.max_wait_ms,
+            max_queue=batcher.max_queue,
+        )
+
+        # Graceful SIGTERM/SIGINT: drain instead of dying.  The flight
+        # recorder's own handlers were installed by the CLI before this;
+        # replacing them here means a signal drains the server (and the
+        # drain itself dumps the flight record), rather than chaining to
+        # the process-killing default.  Restored on exit.
+        import signal
+
+        previous: Dict[int, Any] = {}
+
+        def _on_signal(signum, frame) -> None:
+            try:
+                name = signal.Signals(signum).name
+            except ValueError:  # pragma: no cover
+                name = str(signum)
+            server.request_drain(f"signal:{name}")
+
+        for signum in (signal.SIGTERM, signal.SIGINT):
+            try:
+                previous[signum] = signal.signal(signum, _on_signal)
+            except (ValueError, OSError):  # non-main thread (tests)
+                pass
+        try:
+            if stdio:
+                if not quiet:
+                    print(
+                        f"serve: ready on stdio (max_batch="
+                        f"{batcher.max_batch}, max_wait_ms="
+                        f"{batcher.max_wait_ms}, max_queue="
+                        f"{batcher.max_queue})",
+                        file=sys.stderr,
+                    )
+                server.handle_stream(sys.stdin, sys.stdout,
+                                     drain_on_eof=True)
+            else:
+                if not socket_path:
+                    raise ValueError(
+                        "serve: --socket PATH (or --stdio) is required"
+                    )
+                if not quiet:
+                    print(
+                        f"serve: listening on {socket_path}",
+                        file=sys.stderr,
+                    )
+                server.serve_unix(socket_path)
+        finally:
+            server._drain_batcher()
+            for signum, prev in previous.items():
+                try:
+                    signal.signal(signum, prev)
+                except (ValueError, OSError):
+                    pass
+            stats = server.stats_snapshot()
+            tel.gauge("serving.requests_total",
+                      stats["requests"]["admitted"])
+            tel.gauge("serving.shed_total", stats["requests"]["shed"])
+            if not quiet:
+                reqs = stats["requests"]
+                print(
+                    f"serve: drained ({server.drain_reason or 'eof'}): "
+                    f"{reqs['completed']} completed, {reqs['shed']} shed, "
+                    f"{reqs['batches']} batch(es), occupancy "
+                    f"{reqs['occupancy']}",
+                    file=sys.stderr,
+                )
+    return 0

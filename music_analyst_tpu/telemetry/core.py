@@ -30,7 +30,9 @@ from __future__ import annotations
 
 import itertools
 import json
+import math
 import os
+import random
 import threading
 import time
 from contextlib import contextmanager
@@ -99,16 +101,36 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
-class Histogram:
-    """Fixed-bucket latency histogram (upper-bound buckets + overflow)."""
+# Reservoir size for streaming quantiles.  Exact below the cap; above it
+# a seeded uniform reservoir keeps quantile error ~1/sqrt(cap) — plenty
+# for p99 latency reporting, and deterministic for a fixed value stream.
+_QUANTILE_SAMPLE_CAP = 4096
 
-    __slots__ = ("buckets", "counts", "total", "n")
+# The quantiles every histogram summary exports (serving latency
+# reporting reads these; telemetry-report renders them).
+QUANTILES: Tuple[Tuple[str, float], ...] = (
+    ("p50", 0.50), ("p95", 0.95), ("p99", 0.99),
+)
+
+
+class Histogram:
+    """Fixed-bucket latency histogram (upper-bound buckets + overflow)
+    with streaming min/max and reservoir-sampled p50/p95/p99."""
+
+    __slots__ = ("buckets", "counts", "total", "n", "vmin", "vmax",
+                 "_sample", "_rng")
 
     def __init__(self, buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> None:
         self.buckets = tuple(sorted(buckets))
         self.counts = [0] * (len(self.buckets) + 1)
         self.total = 0.0
         self.n = 0
+        self.vmin = math.inf
+        self.vmax = -math.inf
+        self._sample: List[float] = []
+        # Seeded per histogram: the same value stream always yields the
+        # same quantile estimates (reproducible manifests).
+        self._rng = random.Random(0x5EED)
 
     def observe(self, value: float) -> None:
         for i, bound in enumerate(self.buckets):
@@ -119,14 +141,53 @@ class Histogram:
             self.counts[-1] += 1
         self.total += value
         self.n += 1
+        if value < self.vmin:
+            self.vmin = value
+        if value > self.vmax:
+            self.vmax = value
+        if len(self._sample) < _QUANTILE_SAMPLE_CAP:
+            self._sample.append(value)
+        else:
+            j = self._rng.randrange(self.n)
+            if j < _QUANTILE_SAMPLE_CAP:
+                self._sample[j] = value
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Nearest-rank quantile over the (reservoir) sample; exact while
+        fewer than ``_QUANTILE_SAMPLE_CAP`` values have been observed."""
+        if not self._sample:
+            return None
+        ordered = sorted(self._sample)
+        rank = max(0, math.ceil(q * len(ordered)) - 1)
+        return ordered[min(rank, len(ordered) - 1)]
+
+    def quantiles(self) -> Dict[str, Optional[float]]:
+        ordered = sorted(self._sample)
+        out: Dict[str, Optional[float]] = {}
+        for name, q in QUANTILES:
+            if not ordered:
+                out[name] = None
+                continue
+            rank = max(0, math.ceil(q * len(ordered)) - 1)
+            out[name] = ordered[min(rank, len(ordered) - 1)]
+        return out
 
     def as_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "buckets_le": list(self.buckets) + ["inf"],
             "counts": list(self.counts),
             "count": self.n,
             "sum_s": round(self.total, 9),
         }
+        if self.n:
+            out["min_s"] = round(self.vmin, 9)
+            out["max_s"] = round(self.vmax, 9)
+            out["avg_s"] = round(self.total / self.n, 9)
+            for name, value in self.quantiles().items():
+                out[f"{name}_s"] = (
+                    None if value is None else round(value, 9)
+                )
+        return out
 
 
 class Telemetry:

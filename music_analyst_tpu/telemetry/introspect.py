@@ -197,6 +197,18 @@ def write_run_manifest(
     except Exception:
         pass
     try:
+        # Serving-layer snapshot (protocol, admission counters, batch
+        # occupancy, latency quantiles, residency/warmup state) — present
+        # only when a server ran in this process, so batch runs keep the
+        # original key set.
+        from music_analyst_tpu.serving.server import serving_stats
+
+        serving = serving_stats()
+        if serving:
+            manifest["serving"] = serving
+    except Exception:
+        pass
+    try:
         # Watchdog verdicts + flight-record pointer — only when there is
         # something to say, so unwatched runs keep the original key set.
         from music_analyst_tpu.observability.flight import get_flight_recorder

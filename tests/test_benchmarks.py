@@ -21,7 +21,7 @@ def test_every_advertised_module_registers(monkeypatch):
     for expected in (
         "roofline", "flash_sweep", "generation", "coldstart", "ingest",
         "scaling", "joint", "llama_zeroshot", "sentiment_int8", "bucketing",
-        "overlap", "streaming",
+        "overlap", "streaming", "serving",
     ):
         assert expected in names
 
@@ -30,7 +30,7 @@ def test_every_advertised_module_registers(monkeypatch):
     "name",
     ["roofline", "flash_sweep", "generation", "ingest", "joint",
      "llama_zeroshot", "sentiment_int8", "bucketing", "overlap",
-     "streaming"],
+     "streaming", "serving"],
 )
 def test_suite_runs_smoke(name, monkeypatch):
     monkeypatch.setenv("MUSICAAL_BENCH_SMOKE", "1")

@@ -19,7 +19,8 @@ smoke:
 		$(PY) -m pytest tests/test_profiling.py tests/test_telemetry.py \
 		tests/test_telemetry_contract.py tests/test_runtime_pipeline.py \
 		tests/test_observability.py tests/test_corpus_cache.py \
-		tests/test_wq_store.py tests/test_serving.py -q
+		tests/test_wq_store.py tests/test_serving.py \
+		tests/test_resilience.py -q
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= MUSICAAL_BENCH_SMOKE=1 \
 		$(PY) bench.py --baseline --attempts 1 --deadline 240 \
 		| $(PY) -c "import json,sys; \
@@ -92,6 +93,25 @@ print('smoke ok:', payload['metric'], payload['value'])"
 	print('serving self-check ok:', serving['requests']['batches'], 'batch(es)')" \
 		"$$servetmp/replies.ndjson" "$$servetmp/run_manifest.json" || \
 		{ echo "serving self-check failed"; exit 1; }
+	# chaos self-check: analyze with a transient fault injected at the
+	# ingest seam — the run must recover (retry counter in the manifest)
+	# and write a word_counts.csv byte-identical to the clean run (the
+	# golden contracts hold under injected failure).
+	chaostmp=$$(mktemp -d) && trap 'rm -rf "$$chaostmp"' EXIT && \
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+		$(PY) -m music_analyst_tpu analyze tests/fixtures/mini_songs.csv \
+		--output-dir "$$chaostmp/clean" --no-split >/dev/null || \
+		{ echo "chaos clean run failed"; exit 1; }; \
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+		MUSICAAL_FAULTS="ingest.read:error@1" \
+		$(PY) -m music_analyst_tpu analyze tests/fixtures/mini_songs.csv \
+		--output-dir "$$chaostmp/faulted" --no-split >/dev/null || \
+		{ echo "chaos injected run failed (retry did not recover)"; exit 1; }; \
+	cmp "$$chaostmp/clean/word_counts.csv" "$$chaostmp/faulted/word_counts.csv" || \
+		{ echo "injected-fault word_counts.csv diverged from clean"; exit 1; }; \
+	grep -q '"retry.ingest.read"' "$$chaostmp/faulted/run_manifest.json" || \
+		{ echo "injected run manifest lacks the retry counter"; exit 1; }; \
+	echo "chaos injected-fault self-check ok"
 
 test:
 	$(PY) -m pytest tests/ -q

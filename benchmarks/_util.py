@@ -81,9 +81,20 @@ _BUDGET_SAFETY_S = 15.0
 
 
 def arm_deadline(budget_s: float | None, *, clock=time.monotonic) -> None:
-    """Start the suite-wide wall-clock budget (``None`` disarms)."""
+    """Start the suite-wide wall-clock budget (``None`` disarms).
+
+    Also arms the resilience retry budget: a retry sleep inside a bench
+    suite must never outlive the driver's wall clock, or the contractual
+    JSON line loses to a SIGTERM.
+    """
     global _DEADLINE_AT
     _DEADLINE_AT = None if budget_s is None else clock() + float(budget_s)
+    try:
+        from music_analyst_tpu.resilience.policy import arm_retry_deadline
+
+        arm_retry_deadline(budget_s, clock=clock)
+    except Exception:
+        pass
 
 
 def remaining_budget(*, clock=time.monotonic) -> float | None:

@@ -1,0 +1,214 @@
+"""Chaos suite: injected faults vs. recovery latency and artifact bytes.
+
+Backs the "Injecting faults & measuring recovery" section in
+PERFORMANCE.md.  Each scenario runs the full wordcount engine over the
+same synthetic corpus with one fault rule armed (``resilience/faults.py``
+grammar) and asserts the resilience tentpole's two contracts:
+
+* **byte identity** — every recovered OR degraded run produces
+  ``word_counts.csv`` byte-identical to the clean run (the golden
+  contracts hold under injected failure);
+* **visible recovery** — the injected trips and the retries/failovers
+  that absorbed them appear in the run's telemetry counters.
+
+The reported ``recovery_overhead_s`` is scenario wall time minus the
+clean baseline: what one transient fault at that seam costs end-to-end
+(backoff sleep + re-attempt).  A serving scenario drives the dynamic
+batcher through an injected dispatch failure the same way.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import sys
+import tempfile
+import time
+
+from benchmarks import suite
+from benchmarks._util import device_info, smoke
+
+# (scenario, fault spec, expect_degraded) — specs use the public grammar.
+_SCENARIOS = (
+    ("ingest_transient", "ingest.read:error@1", False),
+    ("prefetch_transient", "prefetch.stage:error@1", False),
+    ("psum_transient", "collective.psum:error@1", False),
+    ("psum_persistent_degrade", "collective.psum:error", True),
+)
+
+_WORDS = (
+    "sunshine shadow river mountain whisper thunder golden silver",
+    "dancing alone together forever tomorrow yesterday morning",
+    "broken hearts mend slowly under winter summer skies above",
+)
+
+
+def _write_corpus(path: str, n_rows: int) -> None:
+    with open(path, "w", encoding="utf-8", newline="") as fh:
+        writer = csv.writer(fh)
+        writer.writerow(["artist", "song", "link", "text"])
+        for i in range(n_rows):
+            writer.writerow([
+                f"Artist {i % 23}",
+                f"Song {i}",
+                f"/a{i % 23}/s{i}",
+                _WORDS[i % len(_WORDS)],
+            ])
+
+
+def _run_once(dataset: str, out_dir: str, chunk_songs: int):
+    from music_analyst_tpu.engines.wordcount import run_analysis
+
+    start = time.perf_counter()
+    run_analysis(
+        dataset,
+        output_dir=out_dir,
+        write_split=False,
+        quiet=True,
+        use_corpus_cache=False,
+        chunk_songs=chunk_songs,
+    )
+    elapsed = time.perf_counter() - start
+    with open(os.path.join(out_dir, "word_counts.csv"), "rb") as fh:
+        return elapsed, fh.read()
+
+
+def _serving_scenario(n_requests: int) -> dict:
+    """Injected dispatch failure: the batcher retry absorbs it."""
+    from music_analyst_tpu.resilience import (
+        configure_faults,
+        fault_stats,
+        reset_retry_stats,
+        retry_stats,
+    )
+    from music_analyst_tpu.serving.batcher import DynamicBatcher
+
+    reset_retry_stats()
+    configure_faults("serving.dispatch:error@1")
+    try:
+        ops = {"echo": lambda texts: [{"label": t} for t in texts]}
+        batcher = DynamicBatcher(
+            ops, max_batch=8, max_wait_ms=1.0, max_queue=n_requests + 1
+        ).start()
+        start = time.perf_counter()
+        reqs = [
+            batcher.submit(i, "echo", f"row {i}") for i in range(n_requests)
+        ]
+        for req in reqs:
+            if not req.wait(timeout=60.0):
+                raise RuntimeError(f"request {req.id} never settled")
+        elapsed = time.perf_counter() - start
+        failed = sum(1 for r in reqs if not (r.response or {}).get("ok"))
+        batcher.drain()
+        return {
+            "scenario": "serving_dispatch_transient",
+            "spec": "serving.dispatch:error@1",
+            "requests": n_requests,
+            "failed_requests": failed,
+            "all_answered": failed == 0,
+            "wall_s": round(elapsed, 4),
+            "faults": fault_stats(),
+            "retries": {
+                site: counts
+                for site, counts in retry_stats().items()
+                if counts.get("retries")
+            },
+        }
+    finally:
+        configure_faults(None)
+
+
+@suite("chaos")
+def run() -> dict:
+    from music_analyst_tpu.resilience import (
+        configure_faults,
+        fault_stats,
+        reset_retry_stats,
+        retry_stats,
+    )
+
+    n_rows, chunk_songs = (200, 64) if smoke() else (20_000, 2_048)
+
+    scenarios = []
+    with tempfile.TemporaryDirectory(prefix="chaos_bench_") as tmp:
+        dataset = os.path.join(tmp, "songs.csv")
+        _write_corpus(dataset, n_rows)
+
+        configure_faults(None)
+        # Untimed warm-up: pay first-compile once, so the clean baseline
+        # and the injected runs compare steady-state against steady-state
+        # and recovery_overhead_s isolates the retry cost.
+        _run_once(dataset, os.path.join(tmp, "warmup"), chunk_songs)
+        clean_s, clean_bytes = _run_once(
+            dataset, os.path.join(tmp, "clean"), chunk_songs
+        )
+        print(f"[chaos] clean baseline: {clean_s:.3f}s "
+              f"({n_rows} rows)", file=sys.stderr)
+
+        for name, spec, expect_degraded in _SCENARIOS:
+            reset_retry_stats()
+            configure_faults(spec)
+            try:
+                wall_s, got = _run_once(
+                    dataset, os.path.join(tmp, name), chunk_songs
+                )
+                faults = fault_stats()  # before disarm clears the registry
+            finally:
+                configure_faults(None)
+            identical = got == clean_bytes
+            degraded = False
+            manifest_path = os.path.join(tmp, name, "run_manifest.json")
+            if os.path.exists(manifest_path):
+                with open(manifest_path, "r", encoding="utf-8") as fh:
+                    degraded = bool(json.load(fh).get("degraded"))
+            trips = sum(
+                int(info.get("trips", 0)) for info in faults.values()
+            )
+            retries = {
+                site: counts
+                for site, counts in retry_stats().items()
+                if counts.get("retries")
+            }
+            row = {
+                "scenario": name,
+                "spec": spec,
+                "bytes_identical": identical,
+                "expect_degraded": expect_degraded,
+                "degraded": degraded,
+                "trips": trips,
+                "retries": retries,
+                "wall_s": round(wall_s, 4),
+                "recovery_overhead_s": round(wall_s - clean_s, 4),
+            }
+            scenarios.append(row)
+            print(
+                f"[chaos] {name}: identical={identical} trips={trips} "
+                f"overhead={row['recovery_overhead_s']:+.3f}s",
+                file=sys.stderr,
+            )
+
+        serving = _serving_scenario(64 if smoke() else 512)
+        print(
+            f"[chaos] serving: answered={serving['all_answered']} "
+            f"wall={serving['wall_s']:.3f}s",
+            file=sys.stderr,
+        )
+
+    reset_retry_stats()
+    return {
+        "suite": "chaos",
+        "device": device_info(),
+        "smoke": smoke(),
+        "rows": n_rows,
+        "chunk_songs": chunk_songs,
+        "clean_wall_s": round(clean_s, 4),
+        "scenarios": scenarios,
+        "serving": serving,
+        "all_identical": all(s["bytes_identical"] for s in scenarios),
+        "all_recovered": all(
+            s["trips"] > 0
+            and (s["degraded"] if s["expect_degraded"] else True)
+            for s in scenarios
+        ) and serving["all_answered"],
+    }

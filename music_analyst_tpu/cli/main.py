@@ -103,6 +103,12 @@ def _add_telemetry_flags(p: argparse.ArgumentParser) -> None:
                         "compile/device scope silent this long dumps a "
                         "classified flight_record.json (default "
                         "$MUSICAAL_WATCHDOG_S, 0 = disabled)")
+    p.add_argument("--inject-faults", default=None, metavar="SPEC",
+                   help="Deterministic fault injection for chaos testing: "
+                        "';'-separated 'site:mode[@trigger][seed=N]' rules, "
+                        "e.g. 'ollama.request:error@2;h2d.transfer:"
+                        "delay=0.5s@1%%seed=7' (default $MUSICAAL_FAULTS; "
+                        "see resilience/faults.py for sites + grammar)")
 
 
 def _add_analyze(sub: argparse._SubParsersAction) -> None:
@@ -383,6 +389,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     install_flight_recorder()
     try:
         start_watchdog(resolve_watchdog_timeout(args.watchdog_timeout))
+    except ValueError as exc:
+        parser.error(str(exc))
+
+    from music_analyst_tpu.resilience import (
+        configure_faults,
+        resolve_fault_spec,
+    )
+
+    # Fault injection is explicit chaos tooling: a malformed spec (flag
+    # OR env) is a hard usage error, never a silent no-op.
+    try:
+        configure_faults(
+            resolve_fault_spec(getattr(args, "inject_faults", None))
+        )
     except ValueError as exc:
         parser.error(str(exc))
 

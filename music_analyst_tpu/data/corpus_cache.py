@@ -49,6 +49,14 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from music_analyst_tpu.resilience.faults import fault_point
+from music_analyst_tpu.resilience.policy import RetryPolicy
+
+# Publish is one rename; transient filesystem errors (and injected
+# corpus_cache.publish faults) get a couple of fast re-attempts before the
+# store is abandoned.  Short sleeps: the caller is blocking an ingest.
+_PUBLISH_RETRY = RetryPolicy(base_s=0.02, cap_s=0.2)
+
 SCHEMA_VERSION = 1
 
 _META_NAME = "meta.json"
@@ -224,8 +232,14 @@ def store(
             with open(os.path.join(tmp, _META_NAME), "w",
                       encoding="utf-8") as fh:
                 json.dump(meta, fh)
-            try:
+            def _publish() -> None:
+                fault_point("corpus_cache.publish", key=key)
                 os.rename(tmp, final)
+
+            try:
+                _PUBLISH_RETRY.call(
+                    _publish, site="corpus_cache.publish"
+                )
             except OSError:
                 # Lost the publish race — the winner's entry is equivalent
                 # (content-addressed), so dropping ours is correct.

@@ -252,10 +252,15 @@ def write_count_csv(
     ``limit`` <= 0 means unlimited, matching the reference's default flag
     values (``src/parallel_spotify.c:32-33``).
     """
+    from music_analyst_tpu.utils.atomic import atomic_write
+
     ordered = sort_count_entries(entries)
     if limit > 0:
         ordered = ordered[:limit]
-    with open(path, "w", encoding="utf-8", newline="") as fh:
+    # Atomic publish: the byte-identity contracts (word_counts.csv vs the
+    # reference binary, cold-vs-warm cache, chaos runs) compare whole
+    # files — a torn half-write under the final name must be impossible.
+    with atomic_write(path, newline="") as fh:
         fh.write("%s,count\n" % key_header)
         for key, value in ordered:
             fh.write(format_count_row(key, value))

@@ -25,6 +25,13 @@ import numpy as np
 from music_analyst_tpu.data.csv_io import iter_dataset_fields
 from music_analyst_tpu.data.tokenizer import tokenize_ascii
 from music_analyst_tpu.data.vocab import Vocab
+from music_analyst_tpu.resilience.faults import fault_point
+from music_analyst_tpu.resilience.policy import RetryPolicy
+
+# Transient read failures (tunnel-mounted corpus, injected ingest.read
+# faults) get re-attempted; the whole ingest is idempotent, so the retry
+# wraps the full backend dispatch rather than just the open().
+_INGEST_RETRY = RetryPolicy(base_s=0.05, cap_s=1.0)
 
 
 @dataclasses.dataclass
@@ -151,35 +158,42 @@ def ingest_dataset(
     """
     if backend not in ("auto", "python", "native"):
         raise ValueError(f"unknown ingest backend: {backend}")
-    if backend in ("auto", "native"):
-        from music_analyst_tpu.data import native
 
-        if native.available():
-            return native.ingest_native(
-                path,
-                limit=limit,
-                num_threads=num_threads,
-                capture_records=capture_records,
-                cache_dir=cache_dir,
-            )
-        if backend == "native":
-            raise RuntimeError(
-                "native ingest requested but the C++ library is unavailable "
-                f"({native.unavailable_reason()})"
-            )
-    if cache_dir:
-        from music_analyst_tpu.data import corpus_cache
+    def _ingest_once() -> IngestResult:
+        fault_point("ingest.read", path=path, backend=backend)
+        if backend in ("auto", "native"):
+            from music_analyst_tpu.data import native
 
-        cached = corpus_cache.load(
-            cache_dir, path, limit, capture_records, "python"
+            if native.available():
+                return native.ingest_native(
+                    path,
+                    limit=limit,
+                    num_threads=num_threads,
+                    capture_records=capture_records,
+                    cache_dir=cache_dir,
+                )
+            if backend == "native":
+                raise RuntimeError(
+                    "native ingest requested but the C++ library is "
+                    f"unavailable ({native.unavailable_reason()})"
+                )
+        if cache_dir:
+            from music_analyst_tpu.data import corpus_cache
+
+            cached = corpus_cache.load(
+                cache_dir, path, limit, capture_records, "python"
+            )
+            if cached is not None:
+                return cached
+        with open(path, "rb") as fh:
+            data = fh.read()
+        result = ingest_python(
+            data, limit=limit, capture_records=capture_records
         )
-        if cached is not None:
-            return cached
-    with open(path, "rb") as fh:
-        data = fh.read()
-    result = ingest_python(data, limit=limit, capture_records=capture_records)
-    if cache_dir:
-        corpus_cache.store(
-            cache_dir, path, limit, capture_records, "python", result
-        )
-    return result
+        if cache_dir:
+            corpus_cache.store(
+                cache_dir, path, limit, capture_records, "python", result
+            )
+        return result
+
+    return _INGEST_RETRY.call(_ingest_once, site="ingest.read")

@@ -30,6 +30,7 @@ import jax
 import numpy as np
 
 from music_analyst_tpu.engines.train import TrainState
+from music_analyst_tpu.resilience.faults import fault_point
 
 
 def _checkpointer():
@@ -202,6 +203,10 @@ def load_quantized_params(
 
     def _stage_quantize(item):
         unit_name, leaves = item
+        # First statement on purpose: an injected checkpoint.load trip
+        # raises before any staging/writer side effect, so the prefetch
+        # stage retry re-runs the unit from scratch.
+        fault_point("checkpoint.load", unit=unit_name)
         float_bytes = sum(_leaf_bytes(leaf) for _, leaf in leaves)
         with _LOAD_LOCK:
             staged["now"] += float_bytes
@@ -229,6 +234,7 @@ def load_quantized_params(
 
     def _stage_h2d(item):
         unit_name, leaves = item
+        fault_point("h2d.transfer", unit=unit_name)
         return unit_name, [
             (path, _device_put_leaf(leaf, path, mesh, axis_names))
             for path, leaf in leaves

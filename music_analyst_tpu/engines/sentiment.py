@@ -31,7 +31,10 @@ from music_analyst_tpu.runtime import (
     Stage,
     resolve_prefetch_depth,
 )
+from music_analyst_tpu.resilience.failover import run_with_failover
+from music_analyst_tpu.resilience.faults import fault_point
 from music_analyst_tpu.telemetry import get_telemetry
+from music_analyst_tpu.utils.atomic import atomic_write
 from music_analyst_tpu.utils.labels import SUPPORTED_LABELS
 
 
@@ -390,9 +393,31 @@ def _run_sentiment_impl(
         with tel.span("compute", rows=len(rows_batch)):
             # collect() is the device-blocking edge — over the loopback
             # tunnel it can hang without erroring; let the watchdog
-            # classify that as device_stall instead of silence.
-            with watchdog.watch("sentiment.collect", kind="device"):
-                labels = clf.collect(handle)
+            # classify that as device_stall instead of silence.  On a
+            # CLASSIFIED device loss the batch is re-submitted once —
+            # through a freshly-built backend when this engine owns
+            # backend construction — before the failure propagates.
+            state = {"handle": handle}
+
+            def _collect():
+                with watchdog.watch("sentiment.collect", kind="device"):
+                    return clf.collect(state["handle"])
+
+            def _reinit():
+                nonlocal clf
+                if backend is None:
+                    clf = get_backend(
+                        model, mock=mock, mesh=mesh,
+                        length_buckets=length_buckets,
+                        weight_quant=weight_quant,
+                    )
+                state["handle"] = clf.submit(
+                    [text for _, _, text in rows_batch]
+                )
+
+            labels, _ = run_with_failover(
+                _collect, site="sentiment.collect", reinit=_reinit
+            )
         elapsed = time.perf_counter() - t_submit
         # Submit→collect wall time per batch — the batched analogue of the
         # reference's per-song HTTP latency column.
@@ -452,6 +477,9 @@ def _run_sentiment_impl(
 
     def h2d_stage(item):
         rows_batch, prepared = item
+        # Injected h2d.transfer faults recover via the prefetch stage
+        # retry (the whole stage body re-runs; launch is idempotent).
+        fault_point("h2d.transfer", rows=len(rows_batch))
         t0 = time.perf_counter()
         handle = clf_launch(clf_transfer(prepared))
         # Snapshot measured latencies NOW: synchronous backends (Ollama)
@@ -484,7 +512,7 @@ def _run_sentiment_impl(
         details_fh.close()
     wall = time.perf_counter() - start
 
-    with open(totals_path, "w", encoding="utf-8") as fh:
+    with atomic_write(totals_path) as fh:
         json.dump(counts, fh, indent=2)
 
     if not quiet:

@@ -43,6 +43,7 @@ from music_analyst_tpu.ops.histogram import (
 )
 from music_analyst_tpu.parallel.mesh import data_parallel_mesh
 from music_analyst_tpu.profiling.trace import annotate
+from music_analyst_tpu.resilience.failover import run_with_failover
 
 
 @dataclasses.dataclass
@@ -136,6 +137,7 @@ def _run_analysis_instrumented(
     else:
         timer.seconds["ingest"] = ingest_seconds
 
+    default_mesh = mesh is None
     if mesh is None:
         mesh = data_parallel_mesh()
 
@@ -153,9 +155,7 @@ def _run_analysis_instrumented(
         count_mode=count_mode,
         chunk_songs=chunk,
     )
-    with timer.stage("device_compute"), watchdog.watch(
-        "wordcount.device_compute", kind="device"
-    ):
+    def _device_counts():
         # np.asarray is the synchronization point: block_until_ready is not
         # reliable on every PJRT plugin, and the engine needs the host
         # copies anyway.  "host-shard" (default, and the faster layout on
@@ -240,6 +240,45 @@ def _run_analysis_instrumented(
             # chip's compute IS the program wall-clock (documented
             # TimeStats.uniform semantics).
             per_chip_compute = None
+        return word_counts, artist_counts, per_chip_compute
+
+    def _host_counts():
+        # Degraded CPU path: the device layouts and this bincount compute
+        # the SAME dense histograms, so the exported CSVs stay
+        # byte-identical (golden contract) — only the per-chip timing
+        # story is lost (uniform wall-clock, like the fused layout).
+        word_ids = np.asarray(corpus.word_ids)
+        artist_ids = np.asarray(corpus.artist_ids)
+        word = np.bincount(
+            word_ids[word_ids >= 0], minlength=max(1, len(corpus.word_vocab))
+        )
+        artist = np.bincount(
+            artist_ids[artist_ids >= 0],
+            minlength=max(1, len(corpus.artist_vocab)),
+        )
+        return word, artist, None
+
+    def _reinit_mesh():
+        # A fresh Mesh re-keys the cached psum programs, forcing a clean
+        # lower+compile against the (possibly recovered) backend.  A
+        # caller-supplied mesh is left alone — replacing it behind the
+        # caller's back could change axis names mid-run.
+        nonlocal mesh
+        if default_mesh:
+            mesh = data_parallel_mesh()
+
+    with timer.stage("device_compute"), watchdog.watch(
+        "wordcount.device_compute", kind="device"
+    ):
+        # Classified backend loss (tunnel_dead / device_stall / injected
+        # transient) gets one re-init-and-retry, then degrades to the
+        # host bincount path with a `degraded: true` manifest stamp.
+        (word_counts, artist_counts, per_chip_compute), _ = run_with_failover(
+            _device_counts,
+            site="wordcount.device_compute",
+            reinit=_reinit_mesh,
+            degrade=_host_counts,
+        )
     if per_chip_compute is None:
         per_chip_compute = [timer.seconds["device_compute"]] * n_chips
     # Grand totals are already global on the host (the reference needs an

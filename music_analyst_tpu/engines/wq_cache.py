@@ -47,7 +47,14 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
+from music_analyst_tpu.resilience.faults import fault_point
+from music_analyst_tpu.resilience.policy import RetryPolicy
+
 SCHEMA_VERSION = 1
+
+# Publish is a single rename; transient FS hiccups get a couple of fast
+# retries before the store degrades to un-cached (never fails the load).
+_PUBLISH_RETRY = RetryPolicy(base_s=0.02, cap_s=0.2)
 
 _META_NAME = "meta.json"
 _HASH_CHUNK = 1 << 22  # 4 MiB reads: streaming hash, bounded memory
@@ -202,9 +209,15 @@ class WqCacheWriter:
             with open(os.path.join(self._tmp, _META_NAME), "w",
                       encoding="utf-8") as fh:
                 json.dump(meta, fh)
-            os.rename(self._tmp, self._final)
-        except OSError:
-            # Benign race: another writer published first.
+
+            def _publish() -> None:
+                fault_point("corpus_cache.publish", key=self._final)
+                os.rename(self._tmp, self._final)
+
+            _PUBLISH_RETRY.call(_publish, site="corpus_cache.publish")
+        except Exception:
+            # Benign race: another writer published first (or an injected
+            # fault exhausted its retries — store degrades, never raises).
             self.abort()
             return os.path.isdir(self._final)
         _bump("stores")

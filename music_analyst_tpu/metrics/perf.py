@@ -74,6 +74,8 @@ def write_performance_metrics(
         payload["per_chip"] = per_chip
     if stages is not None:
         payload["stages"] = {k: round(v, 6) for k, v in stages.items()}
-    with open(path, "w", encoding="utf-8") as fh:
+    from music_analyst_tpu.utils.atomic import atomic_write
+
+    with atomic_write(path) as fh:
         json.dump(payload, fh, indent=2)
         fh.write("\n")

@@ -18,6 +18,12 @@ from typing import List, Sequence
 
 from music_analyst_tpu.engines.sentiment import ClassifierBackend
 from music_analyst_tpu.models.llama import LYRICS_TRUNCATION, PROMPT_TEMPLATE
+from music_analyst_tpu.resilience.faults import fault_point
+from music_analyst_tpu.resilience.policy import (
+    RetryPolicy,
+    classify_retryable,
+    resolve_http_retries,
+)
 from music_analyst_tpu.telemetry import get_telemetry
 from music_analyst_tpu.utils.labels import normalise_label
 
@@ -50,11 +56,33 @@ class OllamaClassifier(ClassifierBackend):
         # Transient-failure retries (upgrade over the reference, which
         # crashes the whole run on the first HTTP error, SURVEY.md §5
         # "Failure detection: fail-fast only").
-        if retries is None:
-            retries = int(os.environ.get("MUSICAAL_HTTP_RETRIES", "2"))
-        self.retries = max(0, retries)
+        self.retries = resolve_http_retries(retries)
         self.backoff_seconds = backoff_seconds
+        # Network-scale backoff: exponential from backoff_seconds with
+        # full jitter, capped well below the request timeout, and never
+        # sleeping past an armed bench deadline.
+        self._retry = RetryPolicy(
+            retries=self.retries,
+            base_s=self.backoff_seconds,
+            cap_s=min(30.0, max(self.backoff_seconds, timeout / 4.0)),
+            classify=self._classify_exc,
+        )
         self.last_latencies: List[float] = []
+
+    @staticmethod
+    def _classify_exc(exc: BaseException):
+        """HTTP-aware retryability: 4xx (bar 408/429) is a verdict."""
+        import requests
+
+        if isinstance(exc, requests.RequestException):
+            status = getattr(
+                getattr(exc, "response", None), "status_code", None
+            )
+            if (status is not None and 400 <= status < 500
+                    and status not in (408, 429)):
+                return False, "http_client_error"
+            return True, "http_error"
+        return classify_retryable(exc)
 
     def _classify_one(self, lyrics: str) -> tuple[str, float]:
         import requests
@@ -67,35 +95,21 @@ class OllamaClassifier(ClassifierBackend):
             "prompt": PROMPT_TEMPLATE.format(lyrics=lyrics[:LYRICS_TRUNCATION]),
             "stream": False,
         }
-        last_exc: Exception | None = None
-        for attempt in range(self.retries + 1):
+        def _request() -> tuple[str, float]:
+            fault_point("ollama.request", model=self.model)
             start = time.perf_counter()
-            try:
-                response = requests.post(
-                    f"{self.endpoint}/api/generate",
-                    json=payload,
-                    timeout=self.timeout,
-                )
-                elapsed = time.perf_counter() - start
-                response.raise_for_status()
-                raw_output = response.json().get("response", "").strip()
-                get_telemetry().observe("ollama.request_seconds", elapsed)
-                return normalise_label(raw_output), elapsed
-            except requests.RequestException as exc:
-                status = getattr(
-                    getattr(exc, "response", None), "status_code", None
-                )
-                # Client errors are not transient — except 408 (request
-                # timeout) and 429 (rate limit), the canonical retryables.
-                if (status is not None and 400 <= status < 500
-                        and status not in (408, 429)):
-                    raise
-                last_exc = exc
-                if attempt < self.retries:
-                    get_telemetry().count("http_retries")
-                    time.sleep(self.backoff_seconds * (2 ** attempt))
-        assert last_exc is not None
-        raise last_exc
+            response = requests.post(
+                f"{self.endpoint}/api/generate",
+                json=payload,
+                timeout=self.timeout,
+            )
+            elapsed = time.perf_counter() - start
+            response.raise_for_status()
+            raw_output = response.json().get("response", "").strip()
+            get_telemetry().observe("ollama.request_seconds", elapsed)
+            return normalise_label(raw_output), elapsed
+
+        return self._retry.call(_request, site="ollama.request")
 
     def classify_batch(self, texts: Sequence[str]) -> List[str]:
         labels: List[str] = []

@@ -36,6 +36,7 @@ _ERROR_PATTERNS = (
         "unable to initialize backend", "backend setup/compile error",
         "unavailable:",
     )),
+    ("fault_injected", ("fault injected", "injectedfault", "injectedfatal")),
     ("host_oom", (
         "memoryerror", "out of memory", "cannot allocate memory",
         "oom-kill",
@@ -126,9 +127,15 @@ def _capture_record(payload: Dict[str, Any], label: str) -> Dict[str, Any]:
 
 
 def _scan_jsonl(path: str) -> Dict[str, Any]:
-    """Cheap single pass over a telemetry.jsonl: event count + trips."""
+    """Cheap single pass over a telemetry.jsonl: event count, watchdog
+    trips, and the resilience events (injected faults, retries,
+    recoveries, failovers) keyed by site."""
     events = 0
     trips: List[Dict[str, Any]] = []
+    faults: Dict[str, int] = {}
+    retries: Dict[str, int] = {}
+    recoveries: Dict[str, int] = {}
+    failovers: Dict[str, int] = {}
     with open(path, "r", encoding="utf-8") as fh:
         for line in fh:
             line = line.strip()
@@ -139,9 +146,31 @@ def _scan_jsonl(path: str) -> Dict[str, Any]:
                 event = json.loads(line)
             except json.JSONDecodeError:
                 continue
-            if event.get("name") == "watchdog_trip":
-                trips.append(event.get("attrs") or {})
-    return {"events": events, "trips": trips}
+            name = event.get("name")
+            attrs = event.get("attrs") or {}
+            site = attrs.get("site", "?")
+            if name == "watchdog_trip":
+                trips.append(attrs)
+            elif name == "fault_injected":
+                faults[site] = faults.get(site, 0) + 1
+            elif name == "retry":
+                retries[site] = retries.get(site, 0) + 1
+            elif name == "retry_recovered":
+                recoveries[site] = recoveries.get(site, 0) + 1
+            elif name in ("failover_retry", "failover_degraded"):
+                failovers[site] = failovers.get(site, 0) + 1
+            elif name == "serving_failover":  # batcher reload — no site attr
+                failovers["serving.dispatch"] = (
+                    failovers.get("serving.dispatch", 0) + 1
+                )
+    return {
+        "events": events,
+        "trips": trips,
+        "faults": faults,
+        "retries": retries,
+        "recoveries": recoveries,
+        "failovers": failovers,
+    }
 
 
 def _dir_record(directory: str, label: str) -> Optional[Dict[str, Any]]:
@@ -188,6 +217,13 @@ def _dir_record(directory: str, label: str) -> Optional[Dict[str, Any]]:
         serving = manifest.get("serving")
         if serving:
             rec["serving"] = serving
+        resilience = manifest.get("resilience")
+        if resilience:
+            rec["resilience"] = resilience
+        if manifest.get("degraded"):
+            rec["degraded"] = True
+            rec["degraded_site"] = manifest.get("degraded_site")
+            rec["degraded_reason"] = manifest.get("degraded_reason")
     if os.path.exists(jsonl_path):
         found = True
         scan = _scan_jsonl(jsonl_path)
@@ -195,6 +231,9 @@ def _dir_record(directory: str, label: str) -> Optional[Dict[str, Any]]:
         if scan["trips"]:
             rec.setdefault("trips", [])
             rec["trips"] = scan["trips"]  # JSONL is ground truth
+        for key in ("faults", "retries", "recoveries", "failovers"):
+            if scan[key]:
+                rec.setdefault("resilience_events", {})[key] = scan[key]
     if os.path.exists(flight_path):
         found = True
         try:
@@ -255,6 +294,16 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     stalls: List[Dict[str, Any]] = []
     recompiles: Dict[str, int] = {}
     latencies: List[Dict[str, Any]] = []
+    resilience_sites: Dict[str, Dict[str, int]] = {}
+    degraded_runs: List[Dict[str, Any]] = []
+
+    def _site(site: str) -> Dict[str, int]:
+        return resilience_sites.setdefault(
+            site,
+            {"trips": 0, "retries": 0, "recoveries": 0,
+             "gave_up": 0, "failovers": 0},
+        )
+
     for rec in records:
         if rec.get("error_kind"):
             taxonomy[rec["error_kind"]] = taxonomy.get(rec["error_kind"], 0) + 1
@@ -285,6 +334,33 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                         "stall_s": stage.get("stall_s"),
                         "queue_depth_max": stage.get("queue_depth_max"),
                     })
+        # Per-site fault/retry/failover rollup.  The manifest's digest is
+        # authoritative where present; JSONL event counts fill in for
+        # dirs whose run died before the manifest landed.
+        resilience = rec.get("resilience") or {}
+        scanned = rec.get("resilience_events") or {}
+        for site, info in (resilience.get("faults") or {}).items():
+            _site(site)["trips"] += int(info.get("trips", 0))
+        for site, info in (resilience.get("retries") or {}).items():
+            entry = _site(site)
+            entry["retries"] += int(info.get("retries", 0))
+            entry["recoveries"] += int(info.get("recoveries", 0))
+            entry["gave_up"] += int(info.get("gave_up", 0))
+        if not resilience:
+            for site, n in (scanned.get("faults") or {}).items():
+                _site(site)["trips"] += int(n)
+            for site, n in (scanned.get("retries") or {}).items():
+                _site(site)["retries"] += int(n)
+            for site, n in (scanned.get("recoveries") or {}).items():
+                _site(site)["recoveries"] += int(n)
+        for site, n in (scanned.get("failovers") or {}).items():
+            _site(site)["failovers"] += int(n)
+        if rec.get("degraded"):
+            degraded_runs.append({
+                "label": rec["label"],
+                "site": rec.get("degraded_site"),
+                "reason": rec.get("degraded_reason"),
+            })
     newest = records[-1] if records else None
     return {
         "schema": 1,
@@ -298,6 +374,8 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "stalls": stalls,
         "recompiles": recompiles,
         "latency_quantiles": latencies,
+        "resilience": dict(sorted(resilience_sites.items())),
+        "degraded_runs": degraded_runs,
         "newest": {
             "label": newest["label"],
             "ok": newest["ok"],
@@ -348,6 +426,21 @@ def render_report(report: Dict[str, Any]) -> List[str]:
                 f"{_fmt(q['p50_s'])} / {_fmt(q['p95_s'])} / "
                 f"{_fmt(q['p99_s'])}"
             )
+    if report.get("resilience"):
+        lines.append(
+            "fault/retry recovery (trips / retries / recoveries / "
+            "gave_up / failovers):"
+        )
+        width = max(len(site) for site in report["resilience"])
+        for site, c in report["resilience"].items():
+            lines.append(
+                f"  {site.ljust(width)}  {c['trips']} / {c['retries']} / "
+                f"{c['recoveries']} / {c['gave_up']} / {c['failovers']}"
+            )
+    for run in report.get("degraded_runs") or []:
+        lines.append(
+            f"  DEGRADED {run['label']}: {run['site']} ({run['reason']})"
+        )
     newest = report.get("newest")
     if newest is not None:
         verdict = ("ok" if newest["ok"]

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from music_analyst_tpu.profiling.collectives import record_collective
 from music_analyst_tpu.profiling.compile import profiled_jit
+from music_analyst_tpu.resilience.faults import fault_point
 from music_analyst_tpu.utils.jax_compat import shard_map
 from music_analyst_tpu.utils.shapes import round_pow2
 
@@ -152,6 +153,7 @@ def sharded_histogram(
         payload_bytes=padded_vocab * 4, n_devices=mesh.shape[axis],
         axis=axis,
     )
+    fault_point("collective.psum", op="histogram.device_ids")
     return _psum_ids_histogram(mesh, axis, padded_vocab)(padded)[:vocab_size]
 
 
@@ -210,6 +212,7 @@ def sharded_histogram_hostlocal_timed(
         payload_bytes=padded_vocab * 4, n_devices=shards, axis=axis,
     )
     t0 = time.perf_counter()
+    fault_point("collective.psum", op="histogram.hostlocal_merge")
     # np.asarray IS the sync point (axon tunnel gotcha — see engine note).
     merged = np.asarray(_psum_rows(mesh, axis)(local))[:vocab_size]
     merge_seconds = time.perf_counter() - t0
@@ -358,6 +361,7 @@ def sharded_histogram_streaming(
         "histogram.stream_merge", "psum",
         payload_bytes=padded_vocab * 4, n_devices=shards, axis=axis,
     )
+    fault_point("collective.psum", op="histogram.stream_merge")
     # np.asarray IS the sync point (axon tunnel gotcha — see engine note).
     return np.asarray(_psum_rows(mesh, axis)(hist))[:vocab_size]
 
@@ -374,4 +378,5 @@ def sharded_total(values: np.ndarray, mesh: Mesh, axis: str = "dp") -> int:
         "histogram.scalar_total", "psum",
         payload_bytes=8, n_devices=mesh.shape[axis], axis=axis,
     )
+    fault_point("collective.psum", op="histogram.scalar_total")
     return int(_psum_scalar(mesh, axis)(padded))

@@ -182,13 +182,25 @@ class ProfiledFunction:
         tel = get_telemetry()
         try:
             from music_analyst_tpu.observability import watchdog
+            from music_analyst_tpu.resilience.faults import fault_point
+            from music_analyst_tpu.resilience.policy import RetryPolicy
+
+            def _lower_and_compile():
+                fault_point("compile.first", fn=self.name)
+                low = self._jit.lower(*args, **kwargs)
+                return low, low.compile()
 
             t0 = time.perf_counter()
             # First compiles are the classic silent-hang site on the
             # tunneled backend; a watchdog trip here reads compile_hang.
+            # Transient failures (tunnel blip, injected compile.first
+            # fault) get re-attempted; a persistent one falls through to
+            # the plain-jit path below — degraded introspection, same
+            # results.
             with watchdog.watch(f"compile:{self.name}", kind="compile"):
-                lowered = self._jit.lower(*args, **kwargs)
-                compiled = lowered.compile()
+                lowered, compiled = RetryPolicy(base_s=0.05, cap_s=1.0).call(
+                    _lower_and_compile, site="compile.first"
+                )
             seconds = time.perf_counter() - t0
         except Exception as exc:
             # Not AOT-eligible (or the backend refused): the plain jit

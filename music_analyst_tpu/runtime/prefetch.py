@@ -49,7 +49,15 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Sequence
 
 from music_analyst_tpu.observability import watchdog
+from music_analyst_tpu.resilience.faults import fault_point
+from music_analyst_tpu.resilience.policy import RetryPolicy
 from music_analyst_tpu.telemetry import get_telemetry
+
+# Stage bodies are retried on transiently-classified failures (tunnel
+# drops, device loss, injected prefetch.stage faults) before poisoning
+# the pipeline; logic errors still fail on the first throw.  Shared by
+# the threaded and inline (depth=0) paths — both go through _timed_fn.
+_STAGE_RETRY = RetryPolicy(base_s=0.05, cap_s=1.0)
 
 DEFAULT_PREFETCH_DEPTH = 2
 
@@ -238,10 +246,16 @@ class PrefetchPipeline:
         t0 = time.perf_counter()
         try:
             with watchdog.watch(f"{self.name}.{stage.name}", kind="stage"):
-                result = stage.fn(item)
+                result = _STAGE_RETRY.call(
+                    self._stage_once, stage, item, site="prefetch.stage"
+                )
         except BaseException as exc:
             return time.perf_counter() - t0, _Failure(exc)
         return time.perf_counter() - t0, result
+
+    def _stage_once(self, stage: Stage, item: Any) -> Any:
+        fault_point("prefetch.stage", stage=stage.name, pipeline=self.name)
+        return stage.fn(item)
 
     def _account(self, stage: Stage, stats: StageStats, dur: float) -> None:
         stats.work_s += dur

@@ -34,6 +34,9 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from music_analyst_tpu.observability import watchdog
+from music_analyst_tpu.resilience.failover import should_failover
+from music_analyst_tpu.resilience.faults import fault_point
+from music_analyst_tpu.resilience.policy import RetryPolicy
 from music_analyst_tpu.telemetry import get_telemetry
 from music_analyst_tpu.telemetry.core import Histogram
 from music_analyst_tpu.utils.shapes import round_pow2
@@ -155,8 +158,18 @@ class DynamicBatcher:
         max_wait_ms: Optional[float] = None,
         max_queue: Optional[int] = None,
         name: str = "serve",
+        failover: Optional[Callable[[BaseException], bool]] = None,
     ) -> None:
         self._ops = dict(ops)
+        # Classified device loss during dispatch tries this hook ONCE per
+        # batch (e.g. ModelResidency.reload) before the one-by-one
+        # isolation fallback — the server survives a device death between
+        # batches instead of failing every queued request.
+        self._failover = failover
+        # Transiently-classified dispatch failures (and injected
+        # serving.dispatch faults) re-attempt in place before any
+        # failover/isolation machinery runs.
+        self._retry = RetryPolicy(base_s=0.05, cap_s=1.0)
         self.max_batch = resolve_max_batch(max_batch)
         self.max_wait_ms = resolve_max_wait_ms(max_wait_ms)
         self.max_queue = resolve_max_queue(max_queue)
@@ -172,6 +185,7 @@ class DynamicBatcher:
             "admitted": 0, "shed": 0, "completed": 0, "failed": 0,
             "bad_request": 0, "batches": 0, "rows": 0, "padded_rows": 0,
             "queue_depth_max": 0, "isolation_retries": 0,
+            "failover_reloads": 0,
         }
 
     # ----------------------------------------------------------- lifecycle
@@ -295,7 +309,31 @@ class DynamicBatcher:
             )
             watchdog.beat("serve.dispatch")
 
-    def _dispatch(self, op: str, batch: List[ServeRequest]) -> None:
+    def _run_op(self, op: str, texts: List[str]) -> List[Dict[str, Any]]:
+        fault_point("serving.dispatch", op=op, rows=len(texts))
+        return self._ops[op](texts)
+
+    def _maybe_failover(self, exc: BaseException) -> bool:
+        """Try the failover hook on classified device loss; True = retry."""
+        if self._failover is None or not should_failover(exc):
+            return False
+        tel = get_telemetry()
+        try:
+            reloaded = bool(self._failover(exc))
+        except Exception as reload_exc:  # noqa: BLE001 — must not kill loop
+            tel.event(
+                "serving_failover_failed", error=str(reload_exc)[:200]
+            )
+            return False
+        if reloaded:
+            self._bump(failover_reloads=1)
+            tel.count("serving.failover_reloads")
+            tel.event("serving_failover", error=str(exc)[:200])
+        return reloaded
+
+    def _dispatch(
+        self, op: str, batch: List[ServeRequest], allow_failover: bool = True
+    ) -> None:
         tel = get_telemetry()
         n = len(batch)
         padded = round_pow2(n, 1)
@@ -307,13 +345,20 @@ class DynamicBatcher:
             # serve_stall instead of a mute socket.
             with watchdog.watch("serve.dispatch", kind="serve"):
                 with tel.span("serve.batch", op=op, rows=n, padded=padded):
-                    results = self._ops[op](texts)[:n]
+                    results = self._retry.call(
+                        self._run_op, op, texts, site="serving.dispatch"
+                    )[:n]
             if len(results) != n:
                 raise RuntimeError(
                     f"op {op!r} returned {len(results)} results for "
                     f"{n} rows"
                 )
         except Exception as exc:  # noqa: BLE001 — isolation boundary
+            # Classified backend loss: reload through the failover hook
+            # and retry the whole batch once before isolating.
+            if allow_failover and self._maybe_failover(exc):
+                self._dispatch(op, batch, allow_failover=False)
+                return
             if n == 1:
                 batch[0].fail(
                     "request_failed",
@@ -327,7 +372,7 @@ class DynamicBatcher:
             self._bump(isolation_retries=1)
             tel.count("serving.isolation_retries")
             for req in batch:
-                self._dispatch(op, [req])
+                self._dispatch(op, [req], allow_failover=False)
             return
         batch_s = time.perf_counter() - t0
         tel.observe("serving.batch_seconds", batch_s)

@@ -68,6 +68,7 @@ class ModelResidency:
             "load_seconds": 0.0,
             "warm": False,
             "warmup": None,
+            "reloads": 0,
         }
 
     # ------------------------------------------------------------- loading
@@ -151,6 +152,30 @@ class ModelResidency:
         with self._lock:
             self._backend = None
             self._state["loaded"] = False
+
+    def current(self):
+        """The resident backend (loading lazily) — resolve PER CALL so a
+        :meth:`reload` swaps the backend under live ops."""
+        backend = self._backend
+        return backend if backend is not None else self.acquire()
+
+    def reload(self):
+        """Drop the (poisoned) backend and load a fresh one.
+
+        The recovery half of reload-on-poisoned-device: the batcher's
+        failover hook calls this when a dispatch failure classifies as
+        device loss, then retries the batch against the new backend —
+        the server survives the device dying between batches.
+        """
+        tel = get_telemetry()
+        with self._lock:
+            self._backend = None
+            self._state["loaded"] = False
+            self._state["warm"] = False
+            self._state["reloads"] += 1
+        tel.count("serving.residency_reloads")
+        tel.event("residency_reload", model=self.model)
+        return self.acquire()
 
     # ------------------------------------------------------------ readouts
 

@@ -91,6 +91,17 @@ def build_ops(clf) -> Dict[str, Any]:
     return {"sentiment": sentiment, "wordcount": _wordcount_batch}
 
 
+def build_resident_ops(residency: ModelResidency) -> Dict[str, Any]:
+    """Op table that resolves the backend through ``residency`` PER CALL,
+    so a failover :meth:`ModelResidency.reload` swaps the model under the
+    live batcher instead of pinning the poisoned instance."""
+    def sentiment(texts: List[str]) -> List[Dict[str, Any]]:
+        labels = residency.current().classify_batch(texts)
+        return [{"label": label} for label in labels]
+
+    return {"sentiment": sentiment, "wordcount": _wordcount_batch}
+
+
 class SentimentServer:
     """Wire protocol + connection lifecycle around a DynamicBatcher."""
 
@@ -361,10 +372,11 @@ def run_server(
                     file=sys.stderr,
                 )
         batcher = DynamicBatcher(
-            build_ops(clf),
+            build_resident_ops(residency),
             max_batch=resolved_batch,
             max_wait_ms=max_wait_ms,
             max_queue=max_queue,
+            failover=lambda exc: residency.reload() is not None,
         ).start()
         server = SentimentServer(
             batcher, residency, mode="stdio" if stdio else "unix"

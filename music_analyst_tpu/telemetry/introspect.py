@@ -156,6 +156,38 @@ def write_run_manifest(
         "event_count": events,
         "telemetry_log": tel.sink_path,
     }
+    # Failover degradation is a headline fact about the run — hoist it
+    # out of the annotation context so readers (and telemetry-report)
+    # never dig for it.  Only present when a failover actually degraded,
+    # so healthy runs keep the original key set.
+    if context.get("degraded"):
+        manifest["degraded"] = True
+        for key in ("degraded_site", "degraded_reason"):
+            if key in context:
+                manifest[key] = context[key]
+    try:
+        # Fault-injection + retry digest (resilience/): per-site trips and
+        # per-site retry/recovery counts — only when something tripped or
+        # retried, so fault-free runs keep the original key set.
+        from music_analyst_tpu.resilience import fault_stats, retry_stats
+
+        faults = fault_stats()
+        # attempts bumps on every guarded call; a site earns a manifest
+        # row only once it actually retried / recovered / gave up.
+        retries = {
+            site: counts
+            for site, counts in retry_stats().items()
+            if counts.get("retries") or counts.get("gave_up")
+        }
+        if faults or retries:
+            resilience: Dict[str, Any] = {}
+            if faults:
+                resilience["faults"] = faults
+            if retries:
+                resilience["retries"] = retries
+            manifest["resilience"] = resilience
+    except Exception:
+        pass
     try:
         # Persistent-corpus-cache hit/miss/bytes-saved — process-lifetime,
         # like the XLA cache stats; only present once the cache has been

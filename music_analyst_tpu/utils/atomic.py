@@ -1,0 +1,52 @@
+"""Atomic artifact writes: stage to a tmp file, publish with one rename.
+
+The engines' output files (``word_counts.csv``, ``top_artists.csv``,
+``performance_metrics.json``, ``sentiment_totals.json``) are contracts —
+resume logic and the differential tests trust whatever is on disk.  A
+crash mid-``write()`` used to leave a torn file under the final name;
+with this helper the final name either holds the previous complete
+artifact or the new complete artifact, never a prefix.  Same pattern the
+corpus/wq caches already use for directory entries (stage under
+``<name>.tmp-<pid>-<uuid>``, publish with one ``os.replace``).
+
+``os.replace`` (not ``rename``) so an existing artifact from a previous
+run is overwritten in one step on every platform.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import uuid
+from typing import IO, Iterator, Optional
+
+
+@contextlib.contextmanager
+def atomic_write(
+    path: str,
+    mode: str = "w",
+    encoding: Optional[str] = "utf-8",
+    newline: Optional[str] = None,
+) -> Iterator[IO]:
+    """Open a staging file that replaces ``path`` only on a clean exit.
+
+    On any exception the staging file is removed and ``path`` is left
+    untouched.  Binary modes pass ``encoding=None``.
+    """
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    tmp = os.path.join(
+        directory,
+        f"{os.path.basename(path)}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}",
+    )
+    fh = open(tmp, mode, encoding=encoding, newline=newline)
+    try:
+        yield fh
+        fh.flush()
+        fh.close()
+        os.replace(tmp, path)
+    except BaseException:
+        fh.close()
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise

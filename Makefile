@@ -20,7 +20,7 @@ smoke:
 		tests/test_telemetry_contract.py tests/test_runtime_pipeline.py \
 		tests/test_observability.py tests/test_corpus_cache.py \
 		tests/test_wq_store.py tests/test_serving.py \
-		tests/test_resilience.py -q
+		tests/test_resilience.py tests/test_continuous.py -q
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= MUSICAAL_BENCH_SMOKE=1 \
 		$(PY) bench.py --baseline --attempts 1 --deadline 240 \
 		| $(PY) -c "import json,sys; \
@@ -93,6 +93,33 @@ print('smoke ok:', payload['metric'], payload['value'])"
 	print('serving self-check ok:', serving['requests']['batches'], 'batch(es)')" \
 		"$$servetmp/replies.ndjson" "$$servetmp/run_manifest.json" || \
 		{ echo "serving self-check failed"; exit 1; }
+	# generate-interleave self-check: one continuous-decode generate
+	# request sandwiched between two sentiment requests on the same
+	# stdio stream — replies must come back in order, the generate reply
+	# must carry text/label/tokens from the slot runtime, and the
+	# manifest's serving section must grow a `decode` block.
+	gentmp=$$(mktemp -d) && trap 'rm -rf "$$gentmp"' EXIT && \
+	printf '%s\n' \
+		'{"id":"g1","op":"sentiment","text":"I love this happy day"}' \
+		'{"id":"g2","op":"generate","text":"sunny morning","max_new_tokens":4}' \
+		'{"id":"g3","op":"sentiment","text":"sad and gray"}' | \
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+		$(PY) -m music_analyst_tpu serve --stdio --model llama-tiny --quiet \
+		--slots 2 --prefill-chunk 32 --max-new-tokens 4 \
+		--max-batch 2 --max-wait-ms 2 --telemetry-dir "$$gentmp" \
+		> "$$gentmp/replies.ndjson" || { echo "generate serve run failed"; exit 1; }; \
+	$(PY) -c "import json,sys; \
+	lines=[json.loads(l) for l in open(sys.argv[1]) if l.strip()]; \
+	assert [r['id'] for r in lines]==['g1','g2','g3'], [r['id'] for r in lines]; \
+	assert all(r['ok'] for r in lines), lines; \
+	gen=lines[1]; \
+	assert gen['op']=='generate' and 'text' in gen and 'label' in gen, gen; \
+	manifest=json.load(open(sys.argv[2])); \
+	decode=manifest['serving']['decode']; \
+	assert decode['completed']==1, decode; \
+	print('generate-interleave self-check ok:', decode['tokens_generated'], 'token(s)')" \
+		"$$gentmp/replies.ndjson" "$$gentmp/run_manifest.json" || \
+		{ echo "generate-interleave self-check failed"; exit 1; }
 	# chaos self-check: analyze with a transient fault injected at the
 	# ingest seam — the run must recover (retry counter in the manifest)
 	# and write a word_counts.csv byte-identical to the clean run (the

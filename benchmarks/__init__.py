@@ -37,6 +37,7 @@ _SUITE_MODULES = (
     "benchmarks.streaming",
     "benchmarks.wq_store",
     "benchmarks.serving",
+    "benchmarks.continuous",
     "benchmarks.chaos",
 )
 

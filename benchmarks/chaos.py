@@ -119,6 +119,58 @@ def _serving_scenario(n_requests: int) -> dict:
         configure_faults(None)
 
 
+def _decode_scenario(n_requests: int) -> dict:
+    """Injected decode-dispatch failure: the continuous scheduler's retry
+    absorbs it and every generate request still settles."""
+    from music_analyst_tpu.models.llama import (
+        LlamaConfig,
+        LlamaZeroShotClassifier,
+    )
+    from music_analyst_tpu.resilience import (
+        configure_faults,
+        fault_stats,
+        reset_retry_stats,
+        retry_stats,
+    )
+    from music_analyst_tpu.serving.decode_loop import ContinuousScheduler
+
+    reset_retry_stats()
+    clf = LlamaZeroShotClassifier(
+        config=LlamaConfig.tiny(), max_prompt_len=64
+    )
+    sched = ContinuousScheduler(
+        clf, n_slots=2, prefill_chunk=16, prompt_region=32,
+        max_new_tokens=4, max_queue=n_requests + 1,
+    )
+    sched.warmup()
+    configure_faults("decode.step:error@1")
+    try:
+        start = time.perf_counter()
+        reqs = [
+            sched.submit(i, f"chaos lyric {i}", max_new_tokens=4)
+            for i in range(n_requests)
+        ]
+        sched.run_until_idle()
+        elapsed = time.perf_counter() - start
+        failed = sum(1 for r in reqs if not (r.response or {}).get("ok"))
+        return {
+            "scenario": "decode_step_transient",
+            "spec": "decode.step:error@1",
+            "requests": n_requests,
+            "failed_requests": failed,
+            "all_answered": failed == 0,
+            "wall_s": round(elapsed, 4),
+            "faults": fault_stats(),
+            "retries": {
+                site: counts
+                for site, counts in retry_stats().items()
+                if counts.get("retries")
+            },
+        }
+    finally:
+        configure_faults(None)
+
+
 @suite("chaos")
 def run() -> dict:
     from music_analyst_tpu.resilience import (
@@ -195,6 +247,13 @@ def run() -> dict:
             file=sys.stderr,
         )
 
+        decode = _decode_scenario(4 if smoke() else 16)
+        print(
+            f"[chaos] decode: answered={decode['all_answered']} "
+            f"wall={decode['wall_s']:.3f}s",
+            file=sys.stderr,
+        )
+
     reset_retry_stats()
     return {
         "suite": "chaos",
@@ -205,10 +264,11 @@ def run() -> dict:
         "clean_wall_s": round(clean_s, 4),
         "scenarios": scenarios,
         "serving": serving,
+        "decode": decode,
         "all_identical": all(s["bytes_identical"] for s in scenarios),
         "all_recovered": all(
             s["trips"] > 0
             and (s["degraded"] if s["expect_degraded"] else True)
             for s in scenarios
-        ) and serving["all_answered"],
+        ) and serving["all_answered"] and decode["all_answered"],
     }

@@ -1,0 +1,177 @@
+"""Continuous vs. static batched decode under a skewed length mix.
+
+Backs PERFORMANCE.md's "Continuous batching" section.  The A/B: the
+same skewed workload (round-robin 1-in-``n_slots`` long-budget request,
+the rest short — so every static batch is hostage to one long row) runs
+through
+
+* **static** — ``generate_batch`` in groups of ``n_slots``, each group
+  decoding to its longest member's budget (the best a static server can
+  do without continuous slots), and
+* **continuous** — the slot scheduler (``serving/decode_loop.py``),
+  where a short request frees its KV slot at its budget and the next
+  prompt prefills into it while the long rows keep decoding.
+
+Useful tokens (per-request budget- and EOS-truncated) are identical by
+construction, so ``speedup = static_wall / continuous_wall``.  The suite
+also asserts the tentpole's two correctness contracts: **byte-identical
+greedy text** per prompt at a uniform budget, and **zero retraces** of
+the three slot programs across the timed workload (compiled-variant
+count flat after warmup).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from benchmarks import suite
+from benchmarks._util import device_info, smoke
+
+_LYRICS = (
+    "golden sunshine on the river and the morning sings",
+    "rain",
+    "shadows fall across the empty street where we used to dance",
+    "my heart beats a broken drum tonight",
+    "la la la",
+    "winter wind and summer fire meet somewhere in between the years",
+)
+
+
+def _workload(n_prompts: int, n_slots: int, long_budget: int,
+              short_budgets=(1, 2, 3)):
+    """Prompts + per-request budgets, one long row per static group."""
+    prompts, budgets = [], []
+    for i in range(n_prompts):
+        prompts.append(f"{_LYRICS[i % len(_LYRICS)]} take {i}")
+        if i % n_slots == 0:
+            budgets.append(long_budget)
+        else:
+            budgets.append(short_budgets[i % len(short_budgets)])
+    return prompts, budgets
+
+
+def _run_continuous(sched, prompts, budgets):
+    reqs = [
+        sched.submit(i, prompt, max_new_tokens=budget)
+        for i, (prompt, budget) in enumerate(zip(prompts, budgets))
+    ]
+    sched.run_until_idle()
+    out = []
+    for req in reqs:
+        resp = req.response or {}
+        if not resp.get("ok"):
+            raise RuntimeError(f"continuous request {req.id} failed: "
+                               f"{resp.get('error')}")
+        out.append(resp)
+    return out
+
+
+def _run_static(clf, prompts, budgets, n_slots):
+    texts = []
+    for lo in range(0, len(prompts), n_slots):
+        group = prompts[lo:lo + n_slots]
+        cap = max(budgets[lo:lo + n_slots])
+        texts.extend(clf.generate_batch(group, max_new_tokens=cap))
+    return texts
+
+
+@suite("continuous")
+def run() -> dict:
+    from music_analyst_tpu.models.llama import (
+        LlamaConfig,
+        LlamaZeroShotClassifier,
+    )
+    from music_analyst_tpu.serving.decode_loop import ContinuousScheduler
+
+    if smoke():
+        n_prompts, n_slots, long_budget = 32, 8, 64
+        max_prompt_len, chunk = 64, 64
+        span = 8
+    else:
+        n_prompts, n_slots, long_budget = 96, 8, 64
+        max_prompt_len, chunk = 256, 64
+        span = 8
+
+    clf = LlamaZeroShotClassifier(
+        config=LlamaConfig.tiny(), max_prompt_len=max_prompt_len
+    )
+    prompts, budgets = _workload(n_prompts, n_slots, long_budget)
+    _, lens = clf.tokenizer.encode_batch(prompts, max_prompt_len)
+    from music_analyst_tpu.utils.shapes import round_pow2
+
+    # Same padded prompt width as the static path, so the KV geometries
+    # (and therefore the greedy tokens) line up row for row.
+    region = min(round_pow2(int(lens.max()), 64), max_prompt_len)
+    sched = ContinuousScheduler(
+        clf, n_slots=n_slots, prefill_chunk=min(chunk, region),
+        prompt_region=region, max_new_tokens=long_budget,
+        decode_span=span, max_queue=n_prompts + 1,
+    )
+    warm = sched.warmup()
+    print(f"[continuous] warmup: {warm['seconds']:.2f}s "
+          f"({warm['programs']} program(s))", file=sys.stderr)
+
+    # Untimed warm passes: static pays its (group, budget) scan shape,
+    # continuous proves slot reuse across a full workload before timing.
+    _run_static(clf, prompts[:n_slots], budgets[:n_slots], n_slots)
+    _run_continuous(sched, prompts[:n_slots], budgets[:n_slots])
+    variants_before = sched.runtime.compiled_variants()
+
+    t0 = time.perf_counter()
+    static_texts = _run_static(clf, prompts, budgets, n_slots)
+    static_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    cont = _run_continuous(sched, prompts, budgets)
+    cont_s = time.perf_counter() - t0
+    retraces = sched.runtime.compiled_variants() - variants_before
+
+    useful_tokens = sum(r["tokens"] for r in cont)
+    speedup = static_s / cont_s if cont_s > 0 else float("inf")
+    print(f"[continuous] static {static_s:.2f}s vs continuous "
+          f"{cont_s:.2f}s ({speedup:.2f}x, {useful_tokens} useful tokens, "
+          f"{retraces} retrace(s))", file=sys.stderr)
+
+    # Byte-identical greedy text at a uniform budget (same scheduler —
+    # per-request budgets just freeze at the cap).
+    eq_prompts = prompts[: 2 * n_slots]
+    eq_budget = [long_budget] * len(eq_prompts)
+    want = _run_static(clf, eq_prompts, eq_budget, n_slots)
+    got = [r["text"] for r in _run_continuous(sched, eq_prompts, eq_budget)]
+    identical = got == want
+    print(f"[continuous] uniform-budget outputs identical: {identical}",
+          file=sys.stderr)
+
+    stats = sched.stats()
+    occ = stats["slot_occupancy_hist"]
+    occupancy_mean = (
+        round(occ["sum_s"] / occ["count"], 4) if occ["count"] else None
+    )
+    return {
+        "suite": "continuous",
+        "device": device_info(),
+        "smoke": smoke(),
+        "n_prompts": n_prompts,
+        "n_slots": n_slots,
+        "prefill_chunk": stats["prefill_chunk"],
+        "prompt_region": stats["prompt_region"],
+        "decode_span": stats["decode_span"],
+        "long_budget": long_budget,
+        "useful_tokens": useful_tokens,
+        "static_wall_s": round(static_s, 4),
+        "continuous_wall_s": round(cont_s, 4),
+        "static_tokens_per_s": round(useful_tokens / static_s, 3),
+        "continuous_tokens_per_s": round(useful_tokens / cont_s, 3),
+        "speedup": round(speedup, 3),
+        "speedup_ok": speedup >= 1.5,
+        "identical_outputs": identical,
+        "retraces": retraces,
+        "zero_retrace": retraces == 0,
+        "slot_occupancy_mean": occupancy_mean,
+        "ttft": stats["ttft"],
+        "tpot": stats["tpot"],
+        "decode_dispatches": stats["decode_dispatches"],
+        "prefill_dispatches": stats["prefill_dispatches"],
+        "warmup": warm,
+    }

@@ -313,6 +313,18 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                    help="Admission queue bound; beyond it requests shed "
                         "with a structured queue_full error (default "
                         "$MUSICAAL_SERVE_MAX_QUEUE or 1024)")
+    p.add_argument("--slots", type=int, default=None,
+                   help="KV slots for the continuous-batching generate op "
+                        "(power of two; 0 disables; default "
+                        "$MUSICAAL_SERVE_SLOTS or 8; requires a "
+                        "generative backend)")
+    p.add_argument("--prefill-chunk", type=int, default=None,
+                   help="Prompt tokens written per chunked-prefill "
+                        "dispatch for the generate op (default "
+                        "$MUSICAAL_SERVE_PREFILL_CHUNK or 64)")
+    p.add_argument("--max-new-tokens", type=int, default=16,
+                   help="Largest per-request generation budget the decode "
+                        "runtime is compiled for (generate op)")
     p.add_argument("--no-warmup", action="store_true",
                    help="Skip the startup warmup batches (first request "
                         "pays compile cost)")
@@ -577,6 +589,9 @@ def _dispatch(parser: argparse.ArgumentParser,
                 max_queue=args.max_queue,
                 warmup=not args.no_warmup,
                 quiet=args.quiet,
+                slots=args.slots,
+                prefill_chunk=args.prefill_chunk,
+                max_new_tokens=args.max_new_tokens,
             )
         except ValueError as exc:
             parser.error(str(exc))

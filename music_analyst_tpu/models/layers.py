@@ -72,7 +72,10 @@ class KVCache:
 
     keys: jax.Array
     values: jax.Array
-    length: jax.Array  # int32 scalar — filled positions
+    # int32 — filled positions.  A scalar means every row shares one write
+    # offset (static batch decode); a ``[B]`` vector gives each row its own
+    # offset (slot-indexed continuous decode, ops/kv_slots.py).
+    length: jax.Array
 
     @classmethod
     def zeros(
@@ -92,12 +95,26 @@ class KVCache:
 
     def update(self, k_new: jax.Array, v_new: jax.Array) -> "KVCache":
         start = self.length
-        keys = jax.lax.dynamic_update_slice(
-            self.keys, k_new.astype(self.keys.dtype), (0, start, 0, 0)
-        )
-        values = jax.lax.dynamic_update_slice(
-            self.values, v_new.astype(self.values.dtype), (0, start, 0, 0)
-        )
+        k_new = k_new.astype(self.keys.dtype)
+        v_new = v_new.astype(self.values.dtype)
+        if start.ndim == 1:
+            # Per-row offsets: each slot writes its new tokens at its own
+            # fill level (dynamic_update_slice clamps, so callers must keep
+            # every row's length strictly below max_len - new + 1).
+            write = jax.vmap(
+                lambda buf, new, s: jax.lax.dynamic_update_slice(
+                    buf, new, (s, 0, 0)
+                )
+            )
+            keys = write(self.keys, k_new, start)
+            values = write(self.values, v_new, start)
+        else:
+            keys = jax.lax.dynamic_update_slice(
+                self.keys, k_new, (0, start, 0, 0)
+            )
+            values = jax.lax.dynamic_update_slice(
+                self.values, v_new, (0, start, 0, 0)
+            )
         return KVCache(keys, values, start + k_new.shape[1])
 
 

@@ -448,6 +448,7 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         seed: int = 0,
         decode_mode: str = "score",
         wq_cache_dir: Optional[str] = None,
+        continuous_slots: Optional[int] = None,
     ) -> None:
         if decode_mode not in ("score", "generate"):
             raise ValueError(
@@ -455,6 +456,22 @@ class LlamaZeroShotClassifier(ClassifierBackend):
                 f"{decode_mode!r}"
             )
         self.decode_mode = decode_mode
+        # > 0 routes classify_batch_by_generation / generate_batch through
+        # the continuous slot runtime (ops/kv_slots.py) at that slot count;
+        # None/0 keeps the static scan path.  Env fallback so CLI runs can
+        # opt in without new plumbing at every call site.
+        if continuous_slots is None:
+            env = os.environ.get("MUSICAAL_CONTINUOUS_SLOTS", "").strip()
+            if env:
+                try:
+                    continuous_slots = int(env)
+                except ValueError:
+                    raise ValueError(
+                        f"MUSICAAL_CONTINUOUS_SLOTS must be an integer, "
+                        f"got {env!r}"
+                    ) from None
+        self.continuous_slots = int(continuous_slots or 0)
+        self._slot_schedulers: dict = {}
         self.config = config or LlamaConfig.tiny()
         self.max_prompt_len = max_prompt_len
         self.tokenizer = resolve_llama_tokenizer(self.config.vocab_size)
@@ -649,8 +666,9 @@ class LlamaZeroShotClassifier(ClassifierBackend):
 
         self._decode_step = _decode_step
 
-        @partial(jax.jit, static_argnames=("max_new_tokens",))
-        def _generate_scan(params, prompt_ids, prompt_lens, max_new_tokens):
+        @partial(jax.jit, static_argnames=("max_new_tokens", "early_exit"))
+        def _generate_scan(params, prompt_ids, prompt_lens, max_new_tokens,
+                           early_exit=True):
             """Batched greedy decode as ONE compiled program.
 
             The reference's generation is a remote server call per song
@@ -658,8 +676,14 @@ class LlamaZeroShotClassifier(ClassifierBackend):
             would still pay one host→device round-trip per token.  Here
             prefill + every decode step run inside a single jit: the token
             loop is a ``lax.scan`` over the KV cache (static trip count,
-            EOS handled by masking, not early exit — XLA-shaped control
-            flow, SURVEY.md §2.4 design notes).
+            EOS handled by masking — XLA-shaped control flow, SURVEY.md
+            §2.4 design notes).  With ``early_exit`` the scan is cut into
+            fixed-size segments under a ``lax.while_loop`` whose predicate
+            stops once every row has emitted EOS: the all-done tail of a
+            short batch is skipped instead of decoded, and because the
+            token buffer is pre-filled with EOS (exactly what the skipped
+            steps would have emitted) the outputs are identical to the
+            full scan.
             """
             B, S = prompt_ids.shape
             positions = jnp.arange(S)[None, :].repeat(B, 0)
@@ -701,12 +725,40 @@ class LlamaZeroShotClassifier(ClassifierBackend):
                 nxt = jnp.where(done, eos, nxt)
                 return (nxt, done, caches), token
 
-            (_, _, caches), tokens = jax.lax.scan(
-                step,
-                (first.astype(jnp.int32), first == eos, caches),
-                jnp.arange(max_new_tokens),
-            )
-            return tokens.T  # [B, max_new_tokens]
+            init = (first.astype(jnp.int32), first == eos, caches)
+            if not early_exit:
+                (_, _, caches), tokens = jax.lax.scan(
+                    step, init, jnp.arange(max_new_tokens)
+                )
+                return tokens.T  # [B, max_new_tokens]
+
+            # Early exit: fixed-size scan segments inside a while_loop with
+            # an all-done predicate between segments.  Segment boundaries
+            # keep the compiled-shape set O(1); the EOS-pre-filled buffer
+            # makes a skipped tail byte-identical to a decoded one (post-
+            # done steps emit exactly EOS).
+            seg = min(8, max_new_tokens)
+            n_seg = -(-max_new_tokens // seg)
+            buf = jnp.full((n_seg * seg, B), eos, jnp.int32)
+
+            def seg_cond(state):
+                k, _, done, _, _ = state
+                return (k < n_seg) & ~jnp.all(done)
+
+            def seg_body(state):
+                k, token, done, caches, buf = state
+                (token, done, caches), seg_tokens = jax.lax.scan(
+                    step, (token, done, caches),
+                    k * seg + jnp.arange(seg),
+                )
+                buf = jax.lax.dynamic_update_slice(
+                    buf, seg_tokens, (k * seg, jnp.asarray(0, jnp.int32))
+                )
+                return (k + 1, token, done, caches, buf)
+
+            state = (jnp.asarray(0, jnp.int32),) + init + (buf,)
+            _, _, _, _, buf = jax.lax.while_loop(seg_cond, seg_body, state)
+            return buf[:max_new_tokens].T  # [B, max_new_tokens]
 
         self._generate_scan = _generate_scan
 
@@ -829,21 +881,25 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         return self.tokenizer.decode(out_tokens)
 
     def generate_batch(
-        self, prompts: Sequence[str], max_new_tokens: int = 16
+        self, prompts: Sequence[str], max_new_tokens: int = 16,
+        early_exit: bool = True,
     ) -> List[str]:
         """Greedy generation for a whole batch in ONE compiled program.
 
         Prefill and all ``max_new_tokens`` decode steps run inside a single
         jit (``lax.scan`` over the KV cache) — no per-token host↔device
         round-trips, unlike :meth:`generate`'s explicit step loop (kept for
-        API parity and as the differential oracle).
+        API parity and as the differential oracle).  ``early_exit`` stops
+        decoding once every row has emitted EOS (identical outputs either
+        way; ``False`` keeps the always-``max_new_tokens`` scan as the
+        equivalence oracle).
         """
         ids, lens = self.tokenizer.encode_batch(prompts, self.max_prompt_len)
         ids, lens = self._trim_prompt_pad(ids, lens)
         tokens = np.asarray(
             self._generate_scan(
                 self.params, jnp.asarray(ids), jnp.asarray(lens),
-                max_new_tokens,
+                max_new_tokens, early_exit=early_exit,
             )
         )
         eos = self.tokenizer.eos_id
@@ -855,6 +911,106 @@ class LlamaZeroShotClassifier(ClassifierBackend):
                     break
                 ids_out.append(int(t))
             outs.append(self.tokenizer.decode(ids_out))
+        return outs
+
+    def slot_runtime(
+        self,
+        n_slots: int = 8,
+        prefill_chunk: int = 64,
+        max_new_tokens: int = 16,
+        prompt_region: Optional[int] = None,
+        decode_span: int = 4,
+    ):
+        """Build the continuous-batching device runtime for this model.
+
+        The presence of this method is the capability probe the serving
+        layer uses (``hasattr(backend, "slot_runtime")``) to decide whether
+        a server can host the ``generate`` task.
+        """
+        from music_analyst_tpu.ops.kv_slots import SlotDecodeRuntime, SlotPlan
+
+        chunk = max(1, min(int(prefill_chunk), self.max_prompt_len))
+        if prompt_region is None:
+            prompt_region = self.max_prompt_len
+        region = min(int(prompt_region), self.max_prompt_len)
+        region = max(chunk, chunk * ((region + chunk - 1) // chunk))
+        plan = SlotPlan(
+            n_slots=int(n_slots),
+            prefill_chunk=chunk,
+            prompt_region=region,
+            max_new=int(max_new_tokens),
+            decode_span=int(decode_span),
+        )
+        eos_id = getattr(self.tokenizer, "eos_id", ByteTokenizer.EOS)
+        return SlotDecodeRuntime(self.model, self.config, plan, eos_id)
+
+    def generate_batch_continuous(
+        self,
+        prompts: Sequence[str],
+        max_new_tokens: int = 16,
+        n_slots: Optional[int] = None,
+        prefill_chunk: int = 64,
+        decode_span: int = 4,
+        budgets: Optional[Sequence[int]] = None,
+    ) -> List[str]:
+        """Greedy generation via the continuous slot runtime, synchronously.
+
+        Same outputs as :meth:`generate_batch` (byte-identical tokens per
+        prompt — the slot cache mirrors the static layout, see
+        ``ops/kv_slots.py``), but requests flow through admit→prefill→
+        decode slots instead of one padded static batch, so rows with
+        small ``budgets`` release their compute to waiting prompts
+        mid-flight.  The scheduler is cached per geometry, so repeat calls
+        reuse the compiled programs.
+        """
+        from music_analyst_tpu.serving.decode_loop import ContinuousScheduler
+        from music_analyst_tpu.utils.shapes import round_pow2
+
+        if not prompts:
+            return []
+        n_slots = int(n_slots or self.continuous_slots or 8)
+        budgets = (
+            [int(b) for b in budgets]
+            if budgets is not None
+            else [int(max_new_tokens)] * len(prompts)
+        )
+        if len(budgets) != len(prompts):
+            raise ValueError("budgets must match prompts 1:1")
+        # Match the static path's padded prompt width exactly so the slot
+        # cache's KV geometry (and therefore every greedy token) lines up
+        # with generate_batch on the same prompts.
+        _, lens = self.tokenizer.encode_batch(prompts, self.max_prompt_len)
+        longest = int(lens.max()) if len(lens) else 1
+        region = min(round_pow2(longest, 64), self.max_prompt_len)
+        chunk = min(int(prefill_chunk), region)
+        cap = max(1, max(budgets))
+        key = (n_slots, chunk, region, cap, int(decode_span))
+        sched = self._slot_schedulers.get(key)
+        if sched is None:
+            sched = ContinuousScheduler(
+                self,
+                n_slots=n_slots,
+                prefill_chunk=chunk,
+                prompt_region=region,
+                max_new_tokens=cap,
+                decode_span=int(decode_span),
+                max_queue=max(len(prompts), 64),
+            )
+            self._slot_schedulers[key] = sched
+        reqs = [
+            sched.submit(i, prompt, max_new_tokens=budget)
+            for i, (prompt, budget) in enumerate(zip(prompts, budgets))
+        ]
+        sched.run_until_idle()
+        outs = []
+        for req in reqs:
+            resp = req.response or {}
+            if not resp.get("ok"):
+                raise RuntimeError(
+                    f"continuous generation failed for prompt {req.id}: "
+                    f"{resp.get('error', 'unknown error')}"
+                )
+            outs.append(resp["text"])
         return outs
 
     def classify_by_generation(self, text: str) -> str:
@@ -874,8 +1030,15 @@ class LlamaZeroShotClassifier(ClassifierBackend):
             for t in texts
         ]
         # Same token budget as generate()'s default so the batch path and
-        # the single-song reference path yield identical labels.
-        generations = self.generate_batch(prompts, max_new_tokens=16)
+        # the single-song reference path yield identical labels.  With
+        # continuous_slots set, batch generation rides the continuous slot
+        # runtime (identical tokens; see generate_batch_continuous).
+        if self.continuous_slots:
+            generations = self.generate_batch_continuous(
+                prompts, max_new_tokens=16, n_slots=self.continuous_slots
+            )
+        else:
+            generations = self.generate_batch(prompts, max_new_tokens=16)
         return [
             "Neutral" if not text.strip() else normalise_label(gen)
             for text, gen in zip(texts, generations)

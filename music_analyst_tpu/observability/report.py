@@ -47,6 +47,7 @@ _ERROR_PATTERNS = (
     )),
     ("stage_stall", ("stage stall", "stage_stall")),
     ("serve_stall", ("serve stall", "serve_stall", "serve.dispatch")),
+    ("decode_stall", ("decode stall", "decode_stall", "decode.dispatch")),
     ("deadline_expired", ("deadline",)),
     ("harness_killed", ("killed by harness", "sigkill")),
 )

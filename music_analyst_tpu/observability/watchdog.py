@@ -52,6 +52,7 @@ TAXONOMY: Dict[str, str] = {
     "device": "device_stall",
     "host": "host_stall",
     "serve": "serve_stall",
+    "decode": "decode_stall",
 }
 
 

@@ -54,6 +54,7 @@ SITES = frozenset(
         "collective.psum",
         "ollama.request",
         "serving.dispatch",
+        "decode.step",
         "checkpoint.load",
     }
 )

@@ -11,19 +11,27 @@ production-inference shape the ROADMAP north star asks for:
   backend holder (weight-quant + persistent caches included);
 * :mod:`music_analyst_tpu.serving.server` — NDJSON protocol over a unix
   socket or stdio, graceful SIGTERM drain, watchdog + flight-recorder
-  integration (the ``serve`` CLI subcommand).
+  integration (the ``serve`` CLI subcommand);
+* :mod:`music_analyst_tpu.serving.decode_loop` — continuous-batching
+  decode scheduler (admit→prefill→decode over the slot-indexed KV cache
+  in ``ops/kv_slots.py``) hosting the ``generate`` op.
 """
 
 from music_analyst_tpu.serving.batcher import (
     DEFAULT_MAX_BATCH,
     DEFAULT_MAX_QUEUE,
     DEFAULT_MAX_WAIT_MS,
+    DEFAULT_PREFILL_CHUNK,
+    DEFAULT_SLOTS,
     DynamicBatcher,
     ServeRequest,
     resolve_max_batch,
     resolve_max_queue,
     resolve_max_wait_ms,
+    resolve_prefill_chunk,
+    resolve_slots,
 )
+from music_analyst_tpu.serving.decode_loop import ContinuousScheduler
 from music_analyst_tpu.serving.residency import ModelResidency, warmup_sizes
 from music_analyst_tpu.serving.server import (
     PROTOCOL,
@@ -34,9 +42,12 @@ from music_analyst_tpu.serving.server import (
 )
 
 __all__ = [
+    "ContinuousScheduler",
     "DEFAULT_MAX_BATCH",
     "DEFAULT_MAX_QUEUE",
     "DEFAULT_MAX_WAIT_MS",
+    "DEFAULT_PREFILL_CHUNK",
+    "DEFAULT_SLOTS",
     "DynamicBatcher",
     "ModelResidency",
     "PROTOCOL",
@@ -46,6 +57,8 @@ __all__ = [
     "resolve_max_batch",
     "resolve_max_queue",
     "resolve_max_wait_ms",
+    "resolve_prefill_chunk",
+    "resolve_slots",
     "run_server",
     "serving_stats",
     "warmup_sizes",

@@ -46,6 +46,12 @@ from music_analyst_tpu.utils.shapes import round_pow2
 DEFAULT_MAX_BATCH = 32
 DEFAULT_MAX_WAIT_MS = 5.0
 DEFAULT_MAX_QUEUE = 1024
+# Continuous decode runtime (serving/decode_loop.py): slot count is
+# rounded up to a power of two (fixed compiled shapes, like max_batch's
+# pow2 padding); prefill chunk is the fixed token width one prefill
+# dispatch writes.
+DEFAULT_SLOTS = 8
+DEFAULT_PREFILL_CHUNK = 64
 
 # Occupancy lives in (0, 1]; the latency-shaped default buckets would
 # put every observation in one bin.
@@ -99,6 +105,23 @@ def resolve_max_queue(value: Any = None) -> int:
                         DEFAULT_MAX_QUEUE, integer=True, minimum=1))
 
 
+def resolve_slots(value: Any = None) -> int:
+    """Decode slot count (``--slots`` / ``$MUSICAAL_SERVE_SLOTS``),
+    rounded up to a power of two — the slot cache is a compiled shape."""
+    return round_pow2(
+        int(_resolve(value, "MUSICAAL_SERVE_SLOTS",
+                     DEFAULT_SLOTS, integer=True, minimum=1)),
+        1,
+    )
+
+
+def resolve_prefill_chunk(value: Any = None) -> int:
+    """Prefill chunk width (``--prefill-chunk`` /
+    ``$MUSICAAL_SERVE_PREFILL_CHUNK``)."""
+    return int(_resolve(value, "MUSICAAL_SERVE_PREFILL_CHUNK",
+                        DEFAULT_PREFILL_CHUNK, integer=True, minimum=1))
+
+
 class ServeRequest:
     """One admitted (or immediately shed) request and its settled reply.
 
@@ -107,15 +130,20 @@ class ServeRequest:
     entirely in the ``id`` the client supplied.
     """
 
-    __slots__ = ("id", "op", "text", "t_enqueue", "_done", "response")
+    __slots__ = ("id", "op", "text", "t_enqueue", "_done", "response",
+                 "meta")
 
-    def __init__(self, rid: Any, op: str, text: str) -> None:
+    def __init__(self, rid: Any, op: str, text: str,
+                 meta: Optional[Dict[str, Any]] = None) -> None:
         self.id = rid
         self.op = op
         self.text = text
         self.t_enqueue = time.monotonic()
         self._done = threading.Event()
         self.response: Optional[Dict[str, Any]] = None
+        # Per-request knobs outside the batch contract (e.g. the decode
+        # loop's max_new_tokens budget); the dynamic batcher ignores it.
+        self.meta: Dict[str, Any] = meta or {}
 
     def complete(self, payload: Dict[str, Any]) -> None:
         self.response = payload

@@ -1,0 +1,582 @@
+"""Continuous-batching scheduler: admit → prefill → decode over KV slots.
+
+The dynamic batcher (``batcher.py``) coalesces *independent* requests
+into one-shot batches; generation is different — a request occupies the
+device for its whole output length, and a static batch holds every row
+hostage to the slowest one.  This scheduler runs the iteration-level
+loop instead (the continuous-batching idea of Orca/vLLM, shaped for
+fixed-program TPU dispatch): ``n_slots`` sequences decode side by side
+in the slot-indexed KV cache (``ops/kv_slots.py``), an admitted request
+claims a free slot *mid-flight*, its prompt is prefilled in fixed-size
+chunks between decode dispatches, and EOS or token-budget completion
+frees the slot immediately so the reply is emitted while neighbors keep
+decoding.  No device program ever retraces as requests come and go.
+
+Reused ``DynamicBatcher`` machinery: the same bounded-admission contract
+(``queue_full`` shed under overload), the same structured-error poison
+isolation (a request whose prefill raises fails alone; co-resident
+slots keep decoding), the same ``RetryPolicy`` around the device edge
+(site ``decode.step``, the ``chaos`` suite's injection point), and the
+same watchdog instrumentation (kind ``decode`` → taxonomy
+``decode_stall``: a wedged dispatch trips the heartbeat monitor instead
+of hanging the server mutely).
+
+Telemetry: slot-occupancy gauge + histogram, tokens/s, and TTFT/TPOT
+reservoir quantiles (``serving.ttft_seconds`` / ``serving.tpot_seconds``
+land in the run manifest next to the batcher's latency quantiles, where
+``telemetry-report`` picks them up).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from music_analyst_tpu.observability import watchdog
+from music_analyst_tpu.resilience.faults import fault_point
+from music_analyst_tpu.resilience.policy import RetryPolicy
+from music_analyst_tpu.serving.batcher import (
+    _LATENCY_BUCKETS,
+    _OCCUPANCY_BUCKETS,
+    ServeRequest,
+    resolve_max_queue,
+    resolve_prefill_chunk,
+    resolve_slots,
+)
+from music_analyst_tpu.telemetry import get_telemetry
+from music_analyst_tpu.telemetry.core import Histogram
+from music_analyst_tpu.utils.labels import normalise_label
+
+# Per-token latency buckets: decode steps are ms-scale on-device, up to
+# second-scale on the CPU-emulated mesh.
+_TOKEN_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0,
+)
+
+
+class _Slot:
+    """Host-side state of one occupied KV slot."""
+
+    __slots__ = ("req", "ids", "plen", "next_chunk", "budget", "steps",
+                 "tokens", "carry", "done", "active", "t_first")
+
+    def __init__(self, req: ServeRequest, ids: np.ndarray, plen: int,
+                 budget: int) -> None:
+        self.req = req
+        self.ids = ids
+        self.plen = int(plen)
+        self.next_chunk = 0        # next prefill chunk offset; -1 = prefilled
+        self.budget = int(budget)
+        self.steps = 0             # decode steps taken so far
+        self.tokens: List[int] = []  # emitted token ids
+        self.carry = 0             # current input token for the next step
+        self.done = False          # emitted EOS (static-path done semantics)
+        self.active = False        # in the decode phase
+        self.t_first: Optional[float] = None  # first-token wall time (TTFT)
+
+
+class ContinuousScheduler:
+    """Admit→prefill→decode loop over a backend's slot runtime.
+
+    ``backend`` must expose ``slot_runtime(...)`` (capability probe),
+    ``params``, and ``tokenizer`` — ``models/llama.py``'s zero-shot
+    classifier is the canonical one.  Usable two ways: synchronously
+    (``submit(...)`` then :meth:`run_until_idle`, the batch-generation
+    path) or threaded (:meth:`start` / :meth:`drain`, the server path).
+    """
+
+    def __init__(
+        self,
+        backend,
+        n_slots: Optional[int] = None,
+        prefill_chunk: Optional[int] = None,
+        prompt_region: Optional[int] = None,
+        max_new_tokens: int = 16,
+        decode_span: int = 4,
+        max_queue: Optional[int] = None,
+    ) -> None:
+        self.backend = backend
+        self.n_slots = resolve_slots(n_slots)
+        self.prefill_chunk = resolve_prefill_chunk(prefill_chunk)
+        self.max_queue = resolve_max_queue(max_queue)
+        self.runtime = backend.slot_runtime(
+            n_slots=self.n_slots,
+            prefill_chunk=self.prefill_chunk,
+            max_new_tokens=max_new_tokens,
+            prompt_region=prompt_region,
+            decode_span=decode_span,
+        )
+        self.plan = self.runtime.plan
+        self.caches = self.runtime.init_caches()
+        self._slots: List[Optional[_Slot]] = [None] * self.plan.n_slots
+        self._queue: deque = deque()
+        self._cond = threading.Condition()
+        self._draining = False
+        self._thread: Optional[threading.Thread] = None
+        self._retry = RetryPolicy(base_s=0.05, cap_s=1.0)
+        self._ttft = Histogram(_LATENCY_BUCKETS)
+        self._tpot = Histogram(_TOKEN_BUCKETS)
+        self._occupancy = Histogram(_OCCUPANCY_BUCKETS)
+        self._stats_lock = threading.Lock()
+        self._stats: Dict[str, Any] = {
+            "admitted": 0, "shed": 0, "completed": 0, "failed": 0,
+            "tokens_generated": 0, "prefill_dispatches": 0,
+            "decode_dispatches": 0, "decode_seconds": 0.0,
+            "queue_depth_max": 0,
+        }
+        self._warmup_record: Optional[Dict[str, Any]] = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "ContinuousScheduler":
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name="decode-loop", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def drain(self, timeout: Optional[float] = 30.0) -> None:
+        """Stop admitting, run every queued/in-flight request to its reply
+        (or a structured error), stop the loop thread."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=timeout)
+        self._thread = None
+        if thread is None:
+            # Synchronous use: drain means "finish the backlog inline".
+            self.run_until_idle()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def warmup(self) -> Dict[str, Any]:
+        """Compile all three slot programs before the first request.
+
+        One dummy prefill chunk + one decode dispatch + one free — after
+        this, every steady-state dispatch reuses these executables (the
+        zero-retrace contract; ``compiled_variants`` should stay flat).
+        """
+        import jax.numpy as jnp
+
+        tel = get_telemetry()
+        before = tel.compile_stats()
+        variants_before = self.runtime.compiled_variants()
+        t0 = time.perf_counter()
+        zero = jnp.asarray(0, jnp.int32)
+        chunk_ids = jnp.zeros((self.plan.prefill_chunk,), jnp.int32)
+        self.caches, _ = self.runtime.prefill_chunk(
+            self.backend.params, self.caches, zero, chunk_ids, zero,
+            jnp.asarray(self.plan.prefill_chunk, jnp.int32), zero,
+        )
+        n = self.plan.n_slots
+        self.caches, _, _, _, _ = self.runtime.decode_step(
+            self.backend.params, self.caches,
+            jnp.zeros((n,), jnp.int32),
+            jnp.ones((n,), jnp.int32),
+            jnp.zeros((n,), jnp.int32),
+            jnp.ones((n,), jnp.int32),
+            jnp.zeros((n,), bool),
+            jnp.zeros((n,), bool),
+        )
+        self.caches = self.runtime.free_slots(
+            self.caches, jnp.ones((n,), bool)
+        )
+        warm_s = time.perf_counter() - t0
+        after = tel.compile_stats()
+        record = {
+            "seconds": round(warm_s, 6),
+            "compiles": after["count"] - before["count"],
+            "programs": self.runtime.compiled_variants() - variants_before,
+            "n_slots": self.plan.n_slots,
+            "prefill_chunk": self.plan.prefill_chunk,
+        }
+        self._warmup_record = record
+        tel.annotate(decode_warmup=record)
+        return record
+
+    # ----------------------------------------------------------- admission
+
+    def submit(self, rid: Any, text: str, op: str = "generate",
+               max_new_tokens: Optional[int] = None) -> ServeRequest:
+        """Admit (or shed) one generation request; mirrors the batcher's
+        bounded-admission contract."""
+        tel = get_telemetry()
+        budget = int(max_new_tokens or self.plan.max_new)
+        budget = max(1, min(budget, self.plan.max_new))
+        req = ServeRequest(rid, op, text, meta={"max_new_tokens": budget})
+        with self._cond:
+            if self._draining:
+                req.fail("draining", "server is draining; not admitting")
+                self._bump(shed=1)
+                tel.count("serving.shed")
+                return req
+            depth = len(self._queue)
+            if depth >= self.max_queue:
+                req.fail(
+                    "queue_full",
+                    f"decode admission queue full ({depth}/{self.max_queue});"
+                    " retry with backoff",
+                )
+                self._bump(shed=1)
+                tel.count("serving.shed")
+                return req
+            self._queue.append(req)
+            depth += 1
+            self._cond.notify_all()
+        with self._stats_lock:
+            self._stats["admitted"] += 1
+            if depth > self._stats["queue_depth_max"]:
+                self._stats["queue_depth_max"] = depth
+        tel.count("serving.decode_admitted")
+        return req
+
+    def _bump(self, **deltas: Any) -> None:
+        with self._stats_lock:
+            for key, n in deltas.items():
+                self._stats[key] += n
+
+    # ------------------------------------------------------------ the loop
+
+    def _loop(self) -> None:
+        while True:
+            did_work = self._tick()
+            if did_work:
+                watchdog.beat("decode.loop")
+                continue
+            with self._cond:
+                if self._draining and not self._queue and not self._occupied():
+                    return
+                self._cond.wait(0.005)
+
+    def run_until_idle(self, max_ticks: int = 1_000_000) -> None:
+        """Synchronous driver: tick until queue and slots are empty."""
+        for _ in range(max_ticks):
+            if not self._tick():
+                with self._cond:
+                    if not self._queue and not self._occupied():
+                        return
+        raise RuntimeError("run_until_idle exceeded its tick bound")
+
+    def _occupied(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    def _tick(self) -> bool:
+        """One scheduler iteration: admit waiting requests into free slots,
+        advance one prefill chunk per mid-prefill slot, run one decode
+        dispatch over all slots, settle completions.  Returns whether any
+        work happened."""
+        did = self._admit()
+        did = self._prefill_tick() or did
+        did = self._decode_tick() or did
+        self._publish_gauges()
+        return did
+
+    # ------------------------------------------------------------ admit
+
+    def _admit(self) -> bool:
+        did = False
+        while True:
+            free = next(
+                (i for i, s in enumerate(self._slots) if s is None), None
+            )
+            if free is None:
+                return did
+            with self._cond:
+                if not self._queue:
+                    return did
+                req = self._queue.popleft()
+            if req.done:  # already shed/settled
+                continue
+            try:
+                ids, plen = self.backend.tokenizer.encode(
+                    req.text, self.plan.prompt_region
+                )
+            except Exception as exc:  # noqa: BLE001 — poison isolation
+                req.fail("request_failed",
+                         f"{type(exc).__name__}: {exc}"[:300])
+                self._bump(failed=1)
+                get_telemetry().count("serving.request_failed")
+                continue
+            self._slots[free] = _Slot(
+                req, np.asarray(ids, np.int32), plen,
+                req.meta.get("max_new_tokens", self.plan.max_new),
+            )
+            did = True
+        return did
+
+    # ------------------------------------------------------------ prefill
+
+    def _device_prefill(self, idx: int, slot: _Slot):
+        """One prefill chunk for one slot (the retried/faulted edge).
+
+        Returns the first-token logits argmax as a *device* array —
+        forcing it here would serialize every slot's prefill behind a
+        host readback; the caller batches the readbacks after all
+        mid-prefill slots have dispatched.
+        """
+        import jax.numpy as jnp
+
+        fault_point("decode.step", phase="prefill", slot=idx)
+        start = slot.next_chunk
+        C = self.plan.prefill_chunk
+        is_last = start + C >= min(max(slot.plen, 1), self.plan.prompt_region)
+        chunk = jnp.asarray(slot.ids[start:start + C])
+        length_after = min(start + C, self.plan.prompt_region)
+        last_index = max(0, min(slot.plen - 1 - start, C - 1))
+        caches, first = self.runtime.prefill_chunk(
+            self.backend.params, self.caches,
+            jnp.asarray(idx, jnp.int32), chunk,
+            jnp.asarray(start, jnp.int32),
+            jnp.asarray(length_after, jnp.int32),
+            jnp.asarray(last_index, jnp.int32),
+        )
+        return caches, first, is_last
+
+    def _prefill_tick(self) -> bool:
+        """Advance every mid-prefill slot by ONE chunk (bounding the
+        latency spike a long prompt injects between decode dispatches)."""
+        import jax
+
+        tel = get_telemetry()
+        did = False
+        finishing = []  # (idx, slot, first_token_device_array)
+        for idx, slot in enumerate(self._slots):
+            if slot is None or slot.next_chunk < 0:
+                continue
+            did = True
+            try:
+                with watchdog.watch("decode.dispatch", kind="decode"):
+                    caches, first, is_last = self._retry.call(
+                        self._device_prefill, idx, slot, site="decode.step"
+                    )
+            except Exception as exc:  # noqa: BLE001 — poison isolation
+                # The poison prompt fails ALONE: its slot is freed (and
+                # zeroed) while co-resident slots keep decoding.
+                slot.req.fail("request_failed",
+                              f"{type(exc).__name__}: {exc}"[:300])
+                self._bump(failed=1)
+                tel.count("serving.request_failed")
+                self._free([idx], zero=True)
+                continue
+            self.caches = caches
+            self._bump(prefill_dispatches=1)
+            if is_last:
+                finishing.append((idx, slot, first))
+            else:
+                slot.next_chunk += self.plan.prefill_chunk
+        if finishing:
+            firsts = jax.device_get([f for _, _, f in finishing])
+            for (idx, slot, _), first in zip(finishing, firsts):
+                slot.next_chunk = -1
+                slot.t_first = time.monotonic()
+                ttft = slot.t_first - slot.req.t_enqueue
+                self._ttft.observe(ttft)
+                tel.observe("serving.ttft_seconds", ttft,
+                            buckets=_LATENCY_BUCKETS)
+                slot.carry = int(first)
+                if slot.carry == self.runtime.eos_id:
+                    # The model's very first token is EOS: empty
+                    # generation, settled without a decode step.
+                    self._settle(idx, slot)
+                else:
+                    slot.active = True
+        return did
+
+    # ------------------------------------------------------------- decode
+
+    def _device_decode(self, tokens, plens, steps, budgets, done, active):
+        fault_point("decode.step", phase="decode",
+                    active=int(active.sum()))
+        import jax.numpy as jnp
+
+        return self.runtime.decode_step(
+            self.backend.params, self.caches,
+            jnp.asarray(tokens), jnp.asarray(plens), jnp.asarray(steps),
+            jnp.asarray(budgets), jnp.asarray(done), jnp.asarray(active),
+        )
+
+    def _decode_tick(self) -> bool:
+        tel = get_telemetry()
+        n = self.plan.n_slots
+        occupied = [
+            (i, s) for i, s in enumerate(self._slots)
+            if s is not None and s.active
+        ]
+        if not occupied:
+            return False
+        tokens = np.zeros(n, np.int32)
+        plens = np.zeros(n, np.int32)
+        steps = np.zeros(n, np.int32)
+        budgets = np.ones(n, np.int32)
+        done = np.zeros(n, bool)
+        active = np.zeros(n, bool)
+        for i, s in occupied:
+            tokens[i] = s.carry
+            plens[i] = s.plen
+            steps[i] = s.steps
+            budgets[i] = s.budget
+            done[i] = s.done
+            active[i] = True
+        t0 = time.perf_counter()
+        try:
+            with watchdog.watch("decode.dispatch", kind="decode"):
+                caches, tok_out, steps_out, done_out, emitted = (
+                    self._retry.call(
+                        self._device_decode, tokens, plens, steps, budgets,
+                        done, active, site="decode.step",
+                    )
+                )
+            import jax
+
+            # One batched D2H readback instead of four serialized ones.
+            emitted, tok_out, steps_out, done_out = jax.device_get(
+                (emitted, tok_out, steps_out, done_out)
+            )
+        except Exception as exc:  # noqa: BLE001 — the loop must survive
+            # Persistent decode failure: every in-flight request gets a
+            # structured error; the slots are freed; the server lives on.
+            detail = f"{type(exc).__name__}: {exc}"[:300]
+            for i, s in occupied:
+                s.req.fail("request_failed", detail)
+            self._bump(failed=len(occupied))
+            tel.count("serving.request_failed", len(occupied))
+            self._free([i for i, _ in occupied], zero=True)
+            return True
+        decode_s = time.perf_counter() - t0
+        self.caches = caches
+        occ = len(occupied) / n
+        with self._stats_lock:
+            self._stats["decode_dispatches"] += 1
+            self._stats["decode_seconds"] += decode_s
+            self._occupancy.observe(occ)
+        tel.observe("serving.slot_occupancy", occ,
+                    buckets=_OCCUPANCY_BUCKETS)
+        freed: List[int] = []
+        for i, s in occupied:
+            emitted_n = int(steps_out[i]) - s.steps
+            s.tokens.extend(int(t) for t in emitted[:emitted_n, i])
+            s.steps = int(steps_out[i])
+            s.carry = int(tok_out[i])
+            s.done = bool(done_out[i])
+            self._bump(tokens_generated=emitted_n)
+            saw_eos = emitted_n > 0 and self.runtime.eos_id in s.tokens[-emitted_n:]
+            if saw_eos or s.steps >= s.budget:
+                freed.append(i)
+        for i in freed:
+            self._settle(i, self._slots[i])
+        return True
+
+    # ------------------------------------------------------------- settle
+
+    def _settle(self, idx: int, slot: _Slot) -> None:
+        """Emit the reply, record TTFT/TPOT, free the slot."""
+        tel = get_telemetry()
+        eos = self.runtime.eos_id
+        toks = slot.tokens
+        if eos in toks:
+            toks = toks[:toks.index(eos)]
+        toks = toks[:slot.budget]
+        text = self.backend.tokenizer.decode(toks)
+        now = time.monotonic()
+        if slot.t_first is not None and len(toks) > 1:
+            tpot = (now - slot.t_first) / (len(toks) - 1)
+            self._tpot.observe(tpot)
+            tel.observe("serving.tpot_seconds", tpot,
+                        buckets=_TOKEN_BUCKETS)
+        slot.req.succeed(
+            text=text,
+            label=normalise_label(text) if text.strip() else "Neutral",
+            tokens=len(toks),
+        )
+        self._bump(completed=1)
+        tel.count("serving.decode_completed")
+        tel.observe("serving.request_seconds", now - slot.req.t_enqueue,
+                    buckets=_LATENCY_BUCKETS)
+        self._free([idx])
+
+    def _free(self, indices: List[int], zero: bool = False) -> None:
+        """Release slots for reuse.
+
+        Normal completion is host-only: the next occupant's prefill
+        overwrites every prompt row it will attend to, the decode step
+        overwrites row ``R + t`` before attending to it, and everything
+        else is masked to an exact-zero attention contribution — so the
+        device zeroing is semantically redundant (the continuous-vs-
+        static byte-identity tests run *with* slot reuse).  Failure
+        paths pass ``zero=True`` to hard-zero a poisoned slot's rows via
+        the ``slots.free`` program anyway: after a fault nothing about
+        the slot's contents is trusted, including the invariants above.
+        """
+        import jax.numpy as jnp
+
+        mask = np.zeros(self.plan.n_slots, bool)
+        for i in indices:
+            mask[i] = True
+            self._slots[i] = None
+        if zero:
+            self.caches = self.runtime.free_slots(
+                self.caches, jnp.asarray(mask)
+            )
+
+    # ----------------------------------------------------------- readouts
+
+    def _publish_gauges(self) -> None:
+        tel = get_telemetry()
+        active = sum(
+            1 for s in self._slots if s is not None and s.active
+        )
+        prefilling = sum(
+            1 for s in self._slots if s is not None and s.next_chunk >= 0
+        )
+        with self._cond:
+            backlog = len(self._queue) + prefilling
+        tel.gauge("serving.decode.active_slots", active)
+        tel.gauge("serving.decode.free_slots",
+                  self.plan.n_slots - self._occupied())
+        tel.gauge("serving.decode.prefill_backlog", backlog)
+
+    def stats(self) -> Dict[str, Any]:
+        """JSON-able snapshot for the ``stats`` control op, the manifest's
+        ``serving.decode`` section, and the ``continuous`` bench suite."""
+        with self._stats_lock:
+            out: Dict[str, Any] = dict(self._stats)
+            ttft = self._ttft.as_dict()
+            tpot = self._tpot.as_dict()
+            occ = self._occupancy.as_dict()
+        with self._cond:
+            backlog = len(self._queue)
+        active = sum(1 for s in self._slots if s is not None and s.active)
+        prefilling = sum(
+            1 for s in self._slots if s is not None and s.next_chunk >= 0
+        )
+        decode_s = out.pop("decode_seconds")
+        out.update(
+            n_slots=self.plan.n_slots,
+            prefill_chunk=self.plan.prefill_chunk,
+            prompt_region=self.plan.prompt_region,
+            max_new_tokens=self.plan.max_new,
+            decode_span=self.plan.decode_span,
+            active_slots=active,
+            free_slots=self.plan.n_slots - self._occupied(),
+            prefill_backlog=backlog + prefilling,
+            decode_seconds=round(decode_s, 6),
+            tokens_per_s=(
+                round(out["tokens_generated"] / decode_s, 3)
+                if decode_s > 0 else None
+            ),
+            ttft=ttft,
+            tpot=tpot,
+            slot_occupancy_hist=occ,
+            compiled_variants=self.runtime.compiled_variants(),
+            warmup=self._warmup_record,
+        )
+        return out

@@ -148,6 +148,18 @@ class ModelResidency:
         tel.annotate(serve_warmup=record)
         return record
 
+    def warmup_decode(self, scheduler) -> Dict[str, Any]:
+        """Compile the continuous-decode slot programs before the first
+        ``generate`` request lands (the decode analogue of :meth:`warmup`:
+        one dummy prefill chunk + decode dispatch + free — after this the
+        runtime's zero-retrace contract holds for the server lifetime)."""
+        tel = get_telemetry()
+        with tel.span("serve.warmup_decode"):
+            record = scheduler.warmup()
+        with self._lock:
+            self._state["decode_warmup"] = record
+        return record
+
     def release(self) -> None:
         with self._lock:
             self._backend = None

@@ -8,9 +8,14 @@ they arrive through the dynamic batcher (``serving/batcher.py``).
 **Protocol** (``ndjson/v1``, loopback-only by construction — a unix
 socket or the process's own stdio; nothing here can reach a network):
 
-* request: ``{"id": <any>, "op": "sentiment"|"wordcount", "text": ...}``
-  (``op`` defaults to ``sentiment``; a missing ``id`` gets an
-  ``auto-<n>`` one).  Control ops: ``ping``, ``stats``, ``shutdown``.
+* request: ``{"id": <any>, "op": "sentiment"|"wordcount"|"generate",
+  "text": ...}`` (``op`` defaults to ``sentiment``; a missing ``id``
+  gets an ``auto-<n>`` one).  Control ops: ``ping``, ``stats``,
+  ``shutdown``.  ``generate`` (generative backends only) additionally
+  accepts ``max_new_tokens`` and rides the continuous-batching decode
+  runtime (``serving/decode_loop.py``) instead of the dynamic batcher:
+  its reply is ``{"text":…, "label":…, "tokens":…}`` and it can
+  overlap with sentiment/wordcount batches on the same connection.
 * response: one JSON line per request, **in request arrival order per
   connection**: ``{"id":…, "ok": true, "op":…, …payload}`` or
   ``{"id":…, "ok": false, "error": {"kind":…, "detail":…}}``.
@@ -110,9 +115,14 @@ class SentimentServer:
         batcher: DynamicBatcher,
         residency: Optional[ModelResidency] = None,
         mode: str = "stdio",
+        decode=None,
     ) -> None:
         self.batcher = batcher
         self.residency = residency
+        # Optional ContinuousScheduler hosting the ``generate`` op; None
+        # when the backend has no slot runtime (e.g. --mock) — generate
+        # requests then settle as bad_request instead of crashing.
+        self.decode = decode
         self.mode = mode
         self.drain_event = threading.Event()
         self.drain_reason: Optional[str] = None
@@ -156,6 +166,8 @@ class SentimentServer:
         with self._drain_lock:
             if not self._drained:
                 self.batcher.drain()
+                if self.decode is not None:
+                    self.decode.drain()
                 self._drained = True
 
     # ------------------------------------------------------------ protocol
@@ -196,6 +208,22 @@ class SentimentServer:
             req = ServeRequest(rid, op, "")
             req.fail("bad_request", "missing/non-string 'text' field")
             return req
+        if op == "generate":
+            if self.decode is None:
+                req = ServeRequest(rid, op, text)
+                req.fail(
+                    "bad_request",
+                    "generate requires a generative backend with a slot "
+                    "runtime (not available on this server)",
+                )
+                return req
+            budget = payload.get("max_new_tokens")
+            if budget is not None and not isinstance(budget, int):
+                req = ServeRequest(rid, op, text)
+                req.fail("bad_request",
+                         "'max_new_tokens' must be an integer")
+                return req
+            return self.decode.submit(rid, text, max_new_tokens=budget)
         return self.batcher.submit(rid, op, text)
 
     # ---------------------------------------------------------- stream I/O
@@ -328,6 +356,8 @@ class SentimentServer:
             "drain_reason": self.drain_reason,
             "requests": self.batcher.stats(),
         }
+        if self.decode is not None:
+            out["decode"] = self.decode.stats()
         if self.residency is not None:
             out["residency"] = self.residency.snapshot()
         return out
@@ -348,6 +378,9 @@ def run_server(
     warmup: bool = True,
     backend=None,
     quiet: bool = False,
+    slots: Optional[int] = None,
+    prefill_chunk: Optional[int] = None,
+    max_new_tokens: int = 16,
 ) -> int:
     """The ``serve`` subcommand: load, warm, then serve until drained.
 
@@ -378,8 +411,36 @@ def run_server(
             max_queue=max_queue,
             failover=lambda exc: residency.reload() is not None,
         ).start()
+        # Continuous decode runtime for the ``generate`` op — only when
+        # the backend exposes a slot runtime (capability probe) and slots
+        # weren't explicitly disabled with --slots=0.
+        decode = None
+        if hasattr(clf, "slot_runtime") and (slots is None or slots > 0):
+            from music_analyst_tpu.serving.decode_loop import (
+                ContinuousScheduler,
+            )
+
+            decode = ContinuousScheduler(
+                clf,
+                n_slots=slots,
+                prefill_chunk=prefill_chunk,
+                max_new_tokens=max_new_tokens,
+                max_queue=max_queue,
+            )
+            if warmup:
+                record = residency.warmup_decode(decode)
+                if not quiet:
+                    print(
+                        f"serve: warmed decode runtime "
+                        f"({record['n_slots']} slot(s)) in "
+                        f"{record['seconds']:.2f}s "
+                        f"({record['compiles']} compile(s))",
+                        file=sys.stderr,
+                    )
+            decode.start()
         server = SentimentServer(
-            batcher, residency, mode="stdio" if stdio else "unix"
+            batcher, residency, mode="stdio" if stdio else "unix",
+            decode=decode,
         )
         tel.annotate(
             backend=getattr(clf, "name", "injected"),
@@ -387,6 +448,7 @@ def run_server(
             max_batch=batcher.max_batch,
             max_wait_ms=batcher.max_wait_ms,
             max_queue=batcher.max_queue,
+            decode_slots=(decode.plan.n_slots if decode is not None else 0),
         )
 
         # Graceful SIGTERM/SIGINT: drain instead of dying.  The flight

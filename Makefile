@@ -20,7 +20,8 @@ smoke:
 		tests/test_telemetry_contract.py tests/test_runtime_pipeline.py \
 		tests/test_observability.py tests/test_corpus_cache.py \
 		tests/test_wq_store.py tests/test_serving.py \
-		tests/test_resilience.py tests/test_continuous.py -q
+		tests/test_resilience.py tests/test_continuous.py \
+		tests/test_kv_pages.py -q
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= MUSICAAL_BENCH_SMOKE=1 \
 		$(PY) bench.py --baseline --attempts 1 --deadline 240 \
 		| $(PY) -c "import json,sys; \
@@ -120,6 +121,35 @@ print('smoke ok:', payload['metric'], payload['value'])"
 	print('generate-interleave self-check ok:', decode['tokens_generated'], 'token(s)')" \
 		"$$gentmp/replies.ndjson" "$$gentmp/run_manifest.json" || \
 		{ echo "generate-interleave self-check failed"; exit 1; }
+	# prefix-cache self-check: the same generate prompt three times on one
+	# stdio stream — with 2 slots the third request must wait for a slot,
+	# so it admits after a completed prefill seeded the radix tree: the
+	# manifest's decode block must report prefix_cache hits >= 1 while the
+	# replies stay identical (sharing may never change output bytes).
+	pctmp=$$(mktemp -d) && trap 'rm -rf "$$pctmp"' EXIT && \
+	printf '%s\n' \
+		'{"id":"p1","op":"generate","text":"sunny morning","max_new_tokens":4}' \
+		'{"id":"p2","op":"generate","text":"sunny morning","max_new_tokens":4}' \
+		'{"id":"p3","op":"generate","text":"sunny morning","max_new_tokens":4}' | \
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+		$(PY) -m music_analyst_tpu serve --stdio --model llama-tiny --quiet \
+		--slots 2 --prefill-chunk 32 --max-new-tokens 4 --page-size 16 \
+		--max-batch 2 --max-wait-ms 2 --telemetry-dir "$$pctmp" \
+		> "$$pctmp/replies.ndjson" || { echo "prefix-cache serve run failed"; exit 1; }; \
+	$(PY) -c "import json,sys; \
+	lines=[json.loads(l) for l in open(sys.argv[1]) if l.strip()]; \
+	assert [r['id'] for r in lines]==['p1','p2','p3'], [r['id'] for r in lines]; \
+	assert all(r['ok'] for r in lines), lines; \
+	texts={r['text'] for r in lines}; \
+	assert len(texts)==1, f'identical prompts diverged: {texts}'; \
+	decode=json.load(open(sys.argv[2]))['serving']['decode']; \
+	assert decode['kv_backend']=='paged', decode['kv_backend']; \
+	pc=decode['prefix_cache']; \
+	assert pc['hits']>=1, pc; \
+	print('prefix-cache self-check ok:', pc['hits'], 'hit(s),', \
+	      pc['tokens_shared'], 'token(s) shared')" \
+		"$$pctmp/replies.ndjson" "$$pctmp/run_manifest.json" || \
+		{ echo "prefix-cache self-check failed"; exit 1; }
 	# chaos self-check: analyze with a transient fault injected at the
 	# ingest seam — the run must recover (retry counter in the manifest)
 	# and write a word_counts.csv byte-identical to the clean run (the

@@ -109,6 +109,11 @@ for suite in $suites; do
         elif [ "$suite" = "streaming" ] && ! grep -q '"corpus_cache"' "$tmp"; then
             rm -f "$tmp"
             echo "    REFUSED: streaming output lacks corpus_cache stats" >&2
+        # The continuous capture must carry the shared-prefix A/B rows
+        # (PERFORMANCE.md reads the prefix-caching TTFT table from it).
+        elif [ "$suite" = "continuous" ] && ! grep -q '"prefix_sharing"' "$tmp"; then
+            rm -f "$tmp"
+            echo "    REFUSED: continuous output lacks prefix_sharing rows" >&2
         else
             mv "$tmp" "$out_dir/$suite.json"
             echo "    captured -> $out_dir/$suite.json" >&2

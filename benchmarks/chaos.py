@@ -171,6 +171,70 @@ def _decode_scenario(n_requests: int) -> dict:
         configure_faults(None)
 
 
+def _prefix_lookup_scenario(n_requests: int) -> dict:
+    """Corrupted/missed radix lookup (site ``kv_pages.lookup``): every
+    faulted admit degrades to a full prefill with zero sharing — the
+    generated bytes must match the clean warm-cache run exactly."""
+    from music_analyst_tpu.models.llama import (
+        PROMPT_TEMPLATE,
+        LlamaConfig,
+        LlamaZeroShotClassifier,
+    )
+    from music_analyst_tpu.resilience import configure_faults, fault_stats
+    from music_analyst_tpu.serving.decode_loop import ContinuousScheduler
+
+    clf = LlamaZeroShotClassifier(
+        config=LlamaConfig.tiny(), max_prompt_len=128
+    )
+    prompts = [
+        PROMPT_TEMPLATE.format(lyrics=f"chaos lyric number {i}")
+        for i in range(n_requests)
+    ]
+    sched = ContinuousScheduler(
+        clf, n_slots=2, prefill_chunk=32, prompt_region=128,
+        max_new_tokens=4, max_queue=n_requests + 1,
+    )
+    sched.warmup()
+
+    def _texts():
+        reqs = [
+            sched.submit(i, p, max_new_tokens=4)
+            for i, p in enumerate(prompts)
+        ]
+        sched.run_until_idle()
+        out = []
+        for req in reqs:
+            resp = req.response or {}
+            if not resp.get("ok"):
+                raise RuntimeError(f"generate {req.id} failed: "
+                                   f"{resp.get('error')}")
+            out.append(resp["text"])
+        return out
+
+    start = time.perf_counter()
+    clean = _texts()  # warm pass — the radix tree now holds every prompt
+    hits_before = sched.stats()["prefix_cache"]["hits"]
+    configure_faults("kv_pages.lookup:error@1+")
+    try:
+        faulted = _texts()
+        faults = fault_stats()
+    finally:
+        configure_faults(None)
+    elapsed = time.perf_counter() - start
+    stats = sched.stats()["prefix_cache"]
+    return {
+        "scenario": "prefix_lookup_corrupt",
+        "spec": "kv_pages.lookup:error@1+",
+        "requests": n_requests,
+        "bytes_identical": faulted == clean,
+        "fallbacks": stats["fallbacks"],
+        "hits_while_faulted": stats["hits"] - hits_before,
+        "all_fell_back": stats["fallbacks"] == n_requests,
+        "trips": sum(int(i.get("trips", 0)) for i in faults.values()),
+        "wall_s": round(elapsed, 4),
+    }
+
+
 @suite("chaos")
 def run() -> dict:
     from music_analyst_tpu.resilience import (
@@ -254,6 +318,14 @@ def run() -> dict:
             file=sys.stderr,
         )
 
+        prefix = _prefix_lookup_scenario(4 if smoke() else 16)
+        print(
+            f"[chaos] prefix_lookup: identical="
+            f"{prefix['bytes_identical']} fallbacks={prefix['fallbacks']} "
+            f"wall={prefix['wall_s']:.3f}s",
+            file=sys.stderr,
+        )
+
     reset_retry_stats()
     return {
         "suite": "chaos",
@@ -265,10 +337,14 @@ def run() -> dict:
         "scenarios": scenarios,
         "serving": serving,
         "decode": decode,
-        "all_identical": all(s["bytes_identical"] for s in scenarios),
+        "prefix_lookup": prefix,
+        "all_identical": all(
+            s["bytes_identical"] for s in scenarios
+        ) and prefix["bytes_identical"],
         "all_recovered": all(
             s["trips"] > 0
             and (s["degraded"] if s["expect_degraded"] else True)
             for s in scenarios
-        ) and serving["all_answered"] and decode["all_answered"],
+        ) and serving["all_answered"] and decode["all_answered"]
+        and prefix["all_fell_back"],
     }

@@ -325,6 +325,15 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--max-new-tokens", type=int, default=16,
                    help="Largest per-request generation budget the decode "
                         "runtime is compiled for (generate op)")
+    p.add_argument("--page-size", type=int, default=None,
+                   help="Tokens per KV page for the paged prefix-shared "
+                        "cache (power of two; 0 pins the monolithic "
+                        "per-slot cache; default $MUSICAAL_SERVE_PAGE_SIZE "
+                        "or 16)")
+    p.add_argument("--kv-pages", type=int, default=None,
+                   help="Physical KV pages in the device pool (>= slots; "
+                        "0 sizes it to slots*pages_per_slot; default "
+                        "$MUSICAAL_SERVE_KV_PAGES or 0)")
     p.add_argument("--no-warmup", action="store_true",
                    help="Skip the startup warmup batches (first request "
                         "pays compile cost)")
@@ -592,6 +601,8 @@ def _dispatch(parser: argparse.ArgumentParser,
                 slots=args.slots,
                 prefill_chunk=args.prefill_chunk,
                 max_new_tokens=args.max_new_tokens,
+                page_size=args.page_size,
+                kv_pages=args.kv_pages,
             )
         except ValueError as exc:
             parser.error(str(exc))

@@ -944,6 +944,57 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         eos_id = getattr(self.tokenizer, "eos_id", ByteTokenizer.EOS)
         return SlotDecodeRuntime(self.model, self.config, plan, eos_id)
 
+    def paged_runtime(
+        self,
+        n_slots: int = 8,
+        prefill_chunk: int = 64,
+        max_new_tokens: int = 16,
+        prompt_region: Optional[int] = None,
+        decode_span: int = 4,
+        page_size: int = 16,
+        kv_pages: int = 0,
+    ):
+        """Build the prefix-shared paged decode runtime for this model.
+
+        The paged sibling of :meth:`slot_runtime` (and the capability
+        probe the serving layer uses for the default KV backend): the
+        per-slot KV buffer becomes a view through an int32 page table
+        over a shared page pool, so sequences with a common token prefix
+        — every zero-shot prompt shares ``PROMPT_TEMPLATE``'s head —
+        can map the same physical pages.  Prefix identity is keyed on
+        *token ids* (whatever tokenizer is resolved), not on text, so
+        byte/llama tokenizers share exactly what their encodings share.
+        ``kv_pages=0`` auto-sizes the pool to one full sequence per slot.
+        """
+        import math
+
+        from music_analyst_tpu.ops.kv_pages import PagedDecodeRuntime, PagePlan
+        from music_analyst_tpu.utils.shapes import round_pow2
+
+        chunk = max(1, min(int(prefill_chunk), self.max_prompt_len))
+        if prompt_region is None:
+            prompt_region = self.max_prompt_len
+        region = min(int(prompt_region), self.max_prompt_len)
+        region = max(chunk, chunk * ((region + chunk - 1) // chunk))
+        page = min(round_pow2(max(1, int(page_size)), 1), region)
+        # The region must be a multiple of both the chunk and the page.
+        unit = math.lcm(chunk, page)
+        region = unit * ((region + unit - 1) // unit)
+        pages_per_slot = region // page + -(-int(max_new_tokens) // page)
+        n_pages = int(kv_pages) or int(n_slots) * pages_per_slot
+        n_pages = max(n_pages, int(n_slots), pages_per_slot)
+        plan = PagePlan(
+            n_slots=int(n_slots),
+            prefill_chunk=chunk,
+            prompt_region=region,
+            max_new=int(max_new_tokens),
+            decode_span=int(decode_span),
+            page_size=page,
+            n_pages=n_pages,
+        )
+        eos_id = getattr(self.tokenizer, "eos_id", ByteTokenizer.EOS)
+        return PagedDecodeRuntime(self.model, self.config, plan, eos_id)
+
     def generate_batch_continuous(
         self,
         prompts: Sequence[str],
@@ -952,6 +1003,9 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         prefill_chunk: int = 64,
         decode_span: int = 4,
         budgets: Optional[Sequence[int]] = None,
+        page_size: Optional[int] = None,
+        kv_pages: Optional[int] = None,
+        prefix_cache: bool = True,
     ) -> List[str]:
         """Greedy generation via the continuous slot runtime, synchronously.
 
@@ -962,6 +1016,13 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         small ``budgets`` release their compute to waiting prompts
         mid-flight.  The scheduler is cached per geometry, so repeat calls
         reuse the compiled programs.
+
+        The KV cache is paged with prefix sharing by default (see
+        :meth:`paged_runtime`): prompts sharing a token-id prefix — the
+        zero-shot template head, repeat songs — skip the shared prefill
+        chunks and share physical pages.  ``page_size=0`` pins the
+        monolithic slot cache; ``prefix_cache=False`` pages without
+        sharing.  All routes emit byte-identical tokens.
         """
         from music_analyst_tpu.serving.decode_loop import ContinuousScheduler
         from music_analyst_tpu.utils.shapes import round_pow2
@@ -984,7 +1045,8 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         region = min(round_pow2(longest, 64), self.max_prompt_len)
         chunk = min(int(prefill_chunk), region)
         cap = max(1, max(budgets))
-        key = (n_slots, chunk, region, cap, int(decode_span))
+        key = (n_slots, chunk, region, cap, int(decode_span),
+               page_size, kv_pages, bool(prefix_cache))
         sched = self._slot_schedulers.get(key)
         if sched is None:
             sched = ContinuousScheduler(
@@ -995,6 +1057,9 @@ class LlamaZeroShotClassifier(ClassifierBackend):
                 max_new_tokens=cap,
                 decode_span=int(decode_span),
                 max_queue=max(len(prompts), 64),
+                page_size=page_size,
+                kv_pages=kv_pages,
+                prefix_cache=prefix_cache,
             )
             self._slot_schedulers[key] = sched
         reqs = [

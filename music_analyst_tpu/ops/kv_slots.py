@@ -35,6 +35,15 @@ tokens byte-identical to ``generate_batch`` (asserted by
 
 All three programs go through :func:`profiled_jit`, so the recompile
 detector (``profiling.recompiles``) is the zero-retrace witness.
+
+This monolithic per-slot layout is the ``page_size=0`` escape hatch of
+the serving stack: the default backend is the prefix-shared *paged*
+runtime (``ops/kv_pages.py``), which keeps the same scheduler, the same
+bit-exactness contract, and the same fixed-program discipline but stores
+KV in a pooled page table so requests sharing a prompt prefix share
+physical pages.  Pin this backend (``--page-size 0``) for decode-heavy
+workloads with no prefix overlap, where the paged gather/scatter
+indirection costs wall time and buys nothing.
 """
 
 from __future__ import annotations

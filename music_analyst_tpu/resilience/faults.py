@@ -56,6 +56,7 @@ SITES = frozenset(
         "serving.dispatch",
         "decode.step",
         "checkpoint.load",
+        "kv_pages.lookup",
     }
 )
 

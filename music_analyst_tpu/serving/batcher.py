@@ -52,6 +52,11 @@ DEFAULT_MAX_QUEUE = 1024
 # dispatch writes.
 DEFAULT_SLOTS = 8
 DEFAULT_PREFILL_CHUNK = 64
+# Paged KV cache (ops/kv_pages.py): tokens per physical page (pow2; 0
+# selects the monolithic per-slot cache) and pool size in pages (0 =
+# auto: n_slots * pages_per_slot, i.e. no oversubscription).
+DEFAULT_PAGE_SIZE = 16
+DEFAULT_KV_PAGES = 0
 
 # Occupancy lives in (0, 1]; the latency-shaped default buckets would
 # put every observation in one bin.
@@ -120,6 +125,48 @@ def resolve_prefill_chunk(value: Any = None) -> int:
     ``$MUSICAAL_SERVE_PREFILL_CHUNK``)."""
     return int(_resolve(value, "MUSICAAL_SERVE_PREFILL_CHUNK",
                         DEFAULT_PREFILL_CHUNK, integer=True, minimum=1))
+
+
+def resolve_page_size(value: Any = None) -> int:
+    """KV page size in tokens (``--page-size`` /
+    ``$MUSICAAL_SERVE_PAGE_SIZE``).
+
+    Must be a power of two (page-gather shapes are compiled); ``0``
+    selects the monolithic per-slot cache of ``ops/kv_slots.py``.  An
+    explicit non-pow2 value raises (usage error); a non-pow2 env value
+    falls back to the default, like every other malformed serve env var.
+    """
+    page = int(_resolve(value, "MUSICAAL_SERVE_PAGE_SIZE",
+                        DEFAULT_PAGE_SIZE, integer=True, minimum=0))
+    if page and (page & (page - 1)):
+        if value is not None:
+            raise ValueError(
+                f"page size must be a power of two (or 0 for the "
+                f"monolithic cache), got {value!r}"
+            )
+        return DEFAULT_PAGE_SIZE
+    return page
+
+
+def resolve_kv_pages(value: Any = None, n_slots: Optional[int] = None) -> int:
+    """KV pool size in pages (``--kv-pages`` /
+    ``$MUSICAAL_SERVE_KV_PAGES``).
+
+    ``0`` means auto-size (one full sequence per slot, no
+    oversubscription).  The pool must hold at least one page per slot:
+    an explicit smaller value raises, a too-small env value falls back
+    to auto.
+    """
+    pages = int(_resolve(value, "MUSICAAL_SERVE_KV_PAGES",
+                         DEFAULT_KV_PAGES, integer=True, minimum=0))
+    if pages and n_slots and pages < n_slots:
+        if value is not None:
+            raise ValueError(
+                f"kv pages ({pages}) must cover at least one page per "
+                f"slot ({n_slots} slots); pass 0 to auto-size"
+            )
+        return DEFAULT_KV_PAGES
+    return pages
 
 
 class ServeRequest:

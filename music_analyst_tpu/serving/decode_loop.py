@@ -12,6 +12,18 @@ chunks between decode dispatches, and EOS or token-budget completion
 frees the slot immediately so the reply is emitted while neighbors keep
 decoding.  No device program ever retraces as requests come and go.
 
+The KV cache behind the slots is paged by default (``ops/kv_pages.py``):
+a fixed device-resident pool of pow2-sized pages, mapped per slot through
+an int32 page table.  At admit the scheduler consults a host-side radix
+tree keyed on the prompt's token ids — a prefix hit pins the shared pages
+(refcounted), maps them into the slot's row, copy-on-writes the
+partially-filled boundary page, and prefills only the suffix chunks; a
+completed prefill's pages are adopted into the tree, completion unpins,
+and a refcount-aware LRU evicts cold pages when the pool fills.  A failed
+or corrupted radix lookup (fault site ``kv_pages.lookup``) falls back to
+a full prefill — a cache problem can cost time, never correctness.  Pass
+``page_size=0`` for the PR-10 monolithic slot cache (kept for A/B).
+
 Reused ``DynamicBatcher`` machinery: the same bounded-admission contract
 (``queue_full`` shed under overload), the same structured-error poison
 isolation (a request whose prefill raises fails alone; co-resident
@@ -37,13 +49,16 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from music_analyst_tpu.observability import watchdog
+from music_analyst_tpu.ops.kv_pages import PagePool, RadixIndex
 from music_analyst_tpu.resilience.faults import fault_point
 from music_analyst_tpu.resilience.policy import RetryPolicy
 from music_analyst_tpu.serving.batcher import (
     _LATENCY_BUCKETS,
     _OCCUPANCY_BUCKETS,
     ServeRequest,
+    resolve_kv_pages,
     resolve_max_queue,
+    resolve_page_size,
     resolve_prefill_chunk,
     resolve_slots,
 )
@@ -62,7 +77,8 @@ class _Slot:
     """Host-side state of one occupied KV slot."""
 
     __slots__ = ("req", "ids", "plen", "next_chunk", "budget", "steps",
-                 "tokens", "carry", "done", "active", "t_first")
+                 "tokens", "carry", "done", "active", "t_first",
+                 "pages", "kv_shared", "skipped")
 
     def __init__(self, req: ServeRequest, ids: np.ndarray, plen: int,
                  budget: int) -> None:
@@ -77,6 +93,9 @@ class _Slot:
         self.done = False          # emitted EOS (static-path done semantics)
         self.active = False        # in the decode phase
         self.t_first: Optional[float] = None  # first-token wall time (TTFT)
+        self.pages: Optional[List[int]] = None  # paged: this slot's table row
+        self.kv_shared = 0         # paged: tokens served from shared pages
+        self.skipped = 0           # paged: prefill chunks skipped by the hit
 
 
 class ContinuousScheduler:
@@ -98,20 +117,59 @@ class ContinuousScheduler:
         max_new_tokens: int = 16,
         decode_span: int = 4,
         max_queue: Optional[int] = None,
+        page_size: Optional[int] = None,
+        kv_pages: Optional[int] = None,
+        prefix_cache: bool = True,
     ) -> None:
         self.backend = backend
         self.n_slots = resolve_slots(n_slots)
         self.prefill_chunk = resolve_prefill_chunk(prefill_chunk)
         self.max_queue = resolve_max_queue(max_queue)
-        self.runtime = backend.slot_runtime(
-            n_slots=self.n_slots,
-            prefill_chunk=self.prefill_chunk,
-            max_new_tokens=max_new_tokens,
-            prompt_region=prompt_region,
-            decode_span=decode_span,
-        )
+        page = resolve_page_size(page_size)
+        self.paged = bool(page) and hasattr(backend, "paged_runtime")
+        if self.paged:
+            self.runtime = backend.paged_runtime(
+                n_slots=self.n_slots,
+                prefill_chunk=self.prefill_chunk,
+                max_new_tokens=max_new_tokens,
+                prompt_region=prompt_region,
+                decode_span=decode_span,
+                page_size=page,
+                kv_pages=resolve_kv_pages(kv_pages, self.n_slots),
+            )
+        else:
+            self.runtime = backend.slot_runtime(
+                n_slots=self.n_slots,
+                prefill_chunk=self.prefill_chunk,
+                max_new_tokens=max_new_tokens,
+                prompt_region=prompt_region,
+                decode_span=decode_span,
+            )
         self.plan = self.runtime.plan
         self.caches = self.runtime.init_caches()
+        if self.paged:
+            plan = self.plan
+            self._pool: Optional[PagePool] = PagePool(plan.n_pages)
+            self._radix: Optional[RadixIndex] = (
+                RadixIndex(plan.page_size) if prefix_cache else None
+            )
+            # Free slots' rows point every entry at the trash page so the
+            # fixed-shape decode dispatch can't scribble on recycled pages.
+            self._table = np.full(
+                (plan.n_slots, plan.pages_per_slot), plan.trash_page,
+                np.int32,
+            )
+            self._prefix: Dict[str, Any] = {
+                "lookups": 0, "hits": 0, "tokens_shared": 0,
+                "pages_shared": 0, "chunks_skipped": 0, "cow_copies": 0,
+                "evictions": 0, "adopted_pages": 0, "fallbacks": 0,
+                "deferred": 0, "fresh_pages": 0,
+            }
+        else:
+            self._pool = None
+            self._radix = None
+            self._table = None
+            self._prefix = {}
         self._slots: List[Optional[_Slot]] = [None] * self.plan.n_slots
         self._queue: deque = deque()
         self._cond = threading.Condition()
@@ -159,11 +217,16 @@ class ContinuousScheduler:
         return self._draining
 
     def warmup(self) -> Dict[str, Any]:
-        """Compile all three slot programs before the first request.
+        """Compile every decode program before the first request.
 
-        One dummy prefill chunk + one decode dispatch + one free — after
-        this, every steady-state dispatch reuses these executables (the
-        zero-retrace contract; ``compiled_variants`` should stay flat).
+        Monolithic: one dummy prefill chunk + one decode dispatch + one
+        free (three programs).  Paged: prefill is run *twice through two
+        different page rows* (the page-table-churn witness: the second
+        mapping must reuse the first executable), then a full-table decode
+        dispatch, a page copy, and a pool-wide free — four programs, after
+        which the pool is zeroed again.  Every steady-state dispatch
+        reuses these executables (the zero-retrace contract;
+        ``compiled_variants`` should stay flat).
         """
         import jax.numpy as jnp
 
@@ -173,23 +236,63 @@ class ContinuousScheduler:
         t0 = time.perf_counter()
         zero = jnp.asarray(0, jnp.int32)
         chunk_ids = jnp.zeros((self.plan.prefill_chunk,), jnp.int32)
-        self.caches, _ = self.runtime.prefill_chunk(
-            self.backend.params, self.caches, zero, chunk_ids, zero,
-            jnp.asarray(self.plan.prefill_chunk, jnp.int32), zero,
-        )
         n = self.plan.n_slots
-        self.caches, _, _, _, _ = self.runtime.decode_step(
-            self.backend.params, self.caches,
-            jnp.zeros((n,), jnp.int32),
-            jnp.ones((n,), jnp.int32),
-            jnp.zeros((n,), jnp.int32),
-            jnp.ones((n,), jnp.int32),
-            jnp.zeros((n,), bool),
-            jnp.zeros((n,), bool),
-        )
-        self.caches = self.runtime.free_slots(
-            self.caches, jnp.ones((n,), bool)
-        )
+        if self.paged:
+            plan = self.plan
+            pps = plan.pages_per_slot
+            length_after = jnp.asarray(plan.prefill_chunk, jnp.int32)
+            # Warm every page count a slot can occupy: two prefills through
+            # shifted page rows (the churn ladder — proves remapping never
+            # retraces), one decode through a full table, one CoW copy.
+            # All of it writes into free pages; the closing free zeroes
+            # the pool, so warmup leaves no residue behind.
+            for shift in (0, 1):
+                row = (
+                    np.arange(pps, dtype=np.int32) + shift
+                ) % plan.n_pages
+                self.caches, _ = self.runtime.prefill_chunk(
+                    self.backend.params, self.caches, jnp.asarray(row),
+                    zero, chunk_ids, zero, length_after, zero,
+                )
+            table = (
+                np.arange(n * pps, dtype=np.int32).reshape(n, pps)
+                % plan.n_pages
+            )
+            self.caches, _, _, _, _ = self.runtime.decode_step(
+                self.backend.params, self.caches, jnp.asarray(table),
+                jnp.zeros((n,), jnp.int32),
+                jnp.ones((n,), jnp.int32),
+                jnp.zeros((n,), jnp.int32),
+                jnp.ones((n,), jnp.int32),
+                jnp.zeros((n,), bool),
+                jnp.zeros((n,), bool),
+            )
+            self.caches = self.runtime.copy_page(
+                self.caches, zero,
+                jnp.asarray(min(1, plan.n_pages - 1), jnp.int32),
+            )
+            self.caches = self.runtime.free_pages(
+                self.caches,
+                jnp.ones((plan.n_pages + 1,), bool),
+                jnp.ones((n,), bool),
+            )
+        else:
+            self.caches, _ = self.runtime.prefill_chunk(
+                self.backend.params, self.caches, zero, chunk_ids, zero,
+                jnp.asarray(self.plan.prefill_chunk, jnp.int32), zero,
+            )
+            self.caches, _, _, _, _ = self.runtime.decode_step(
+                self.backend.params, self.caches,
+                jnp.zeros((n,), jnp.int32),
+                jnp.ones((n,), jnp.int32),
+                jnp.zeros((n,), jnp.int32),
+                jnp.ones((n,), jnp.int32),
+                jnp.zeros((n,), bool),
+                jnp.zeros((n,), bool),
+            )
+            self.caches = self.runtime.free_slots(
+                self.caches, jnp.ones((n,), bool)
+            )
         warm_s = time.perf_counter() - t0
         after = tel.compile_stats()
         record = {
@@ -198,7 +301,14 @@ class ContinuousScheduler:
             "programs": self.runtime.compiled_variants() - variants_before,
             "n_slots": self.plan.n_slots,
             "prefill_chunk": self.plan.prefill_chunk,
+            "kv_backend": "paged" if self.paged else "slots",
         }
+        if self.paged:
+            record.update(
+                page_size=self.plan.page_size,
+                kv_pages=self.plan.n_pages,
+                pages_per_slot=self.plan.pages_per_slot,
+            )
         self._warmup_record = record
         tel.annotate(decode_warmup=record)
         return record
@@ -306,12 +416,118 @@ class ContinuousScheduler:
                 self._bump(failed=1)
                 get_telemetry().count("serving.request_failed")
                 continue
-            self._slots[free] = _Slot(
+            slot = _Slot(
                 req, np.asarray(ids, np.int32), plen,
                 req.meta.get("max_new_tokens", self.plan.max_new),
             )
+            if self.paged and not self._map_pages(free, slot):
+                # Not even eviction could free enough pages: put the
+                # request back and stop admitting this tick — in-flight
+                # sequences completing will release pages.
+                with self._cond:
+                    self._queue.appendleft(req)
+                with self._stats_lock:
+                    self._prefix["deferred"] += 1
+                return did
+            self._slots[free] = slot
             did = True
         return did
+
+    def _map_pages(self, idx: int, slot: _Slot) -> bool:
+        """Build the slot's page-table row, sharing what the radix tree
+        already holds.
+
+        A prefix hit pins the matched full pages in place and maps them;
+        the partially-filled boundary page is copy-on-write'd so shared
+        tokens are never overwritten; the remainder is freshly allocated,
+        evicting cold unpinned pages if the pool is full.  A failed or
+        corrupted lookup (fault site ``kv_pages.lookup``) degrades to a
+        full prefill with zero sharing — identical output bytes, just no
+        savings.  Returns False when the pool can't cover the row even
+        after eviction (the caller defers admission)."""
+        import jax.numpy as jnp
+
+        plan = self.plan
+        pool = self._pool
+        shared: List[int] = []
+        cow_src: Optional[int] = None
+        kv_shared = 0
+        if self._radix is not None:
+            try:
+                fault_point("kv_pages.lookup", tokens=slot.plen)
+                match = self._radix.match(slot.ids[:slot.plen])
+                shared = list(match.pages)
+                kv_shared = match.tokens
+                if match.partial_tokens:
+                    cow_src = match.partial_phys
+            except Exception:  # noqa: BLE001 — cache-miss semantics
+                shared, cow_src, kv_shared = [], None, 0
+                with self._stats_lock:
+                    self._prefix["fallbacks"] += 1
+                get_telemetry().count("serving.prefix_lookup_fallback")
+        bp = len(shared)  # slot-local index of the first private page
+        for phys in shared:
+            pool.pin(phys)
+        if cow_src is not None:
+            pool.pin(cow_src)  # protect the CoW source from eviction
+        needed = plan.pages_per_slot - bp
+        if pool.free_count < needed and self._radix is not None:
+            evicted = self._radix.evict(pool, needed - pool.free_count)
+            if evicted:
+                with self._stats_lock:
+                    self._prefix["evictions"] += evicted
+        fresh = pool.alloc(needed)
+        if fresh is None:
+            for phys in shared:
+                pool.unpin(phys)
+            if cow_src is not None:
+                pool.unpin(cow_src)
+            return False
+        for phys in fresh:
+            pool.pin(phys)
+        row = shared + fresh
+        self._table[idx] = np.asarray(row, np.int32)
+        slot.pages = row
+        slot.kv_shared = kv_shared
+        if cow_src is not None:
+            self.caches = self.runtime.copy_page(
+                self.caches, jnp.asarray(cow_src, jnp.int32),
+                jnp.asarray(row[bp], jnp.int32),
+            )
+            pool.unpin(cow_src)
+        # Skip the fully-shared prefill chunks.  The boundary chunk reruns
+        # (rows below kv_shared recompute to identical bytes; rows at or
+        # above it land in the CoW/fresh pages), and the final chunk always
+        # runs, so the first-token logits come from the same program and
+        # inputs as a cold prefill — byte-identical greedy tokens.
+        C = plan.prefill_chunk
+        eff = min(kv_shared, max(slot.plen, 1) - 1)
+        slot.next_chunk = (eff // C) * C
+        slot.skipped = slot.next_chunk // C
+        with self._stats_lock:
+            self._prefix["lookups"] += 1
+            if kv_shared > 0:
+                self._prefix["hits"] += 1
+            self._prefix["tokens_shared"] += kv_shared
+            self._prefix["pages_shared"] += bp
+            self._prefix["chunks_skipped"] += slot.skipped
+            self._prefix["fresh_pages"] += len(fresh)
+            if cow_src is not None:
+                self._prefix["cow_copies"] += 1
+        return True
+
+    def _adopt(self, slot: _Slot) -> None:
+        """Offer a completed prefill's prompt pages to the radix tree so
+        future prompts can share them; runs already cached aren't
+        re-adopted (the slot's duplicates free on completion)."""
+        try:
+            n = min(slot.plen, self.plan.prompt_region)
+            adopted = self._radix.insert(slot.ids[:n], slot.pages, self._pool)
+        except Exception:  # noqa: BLE001 — cache trouble must not fail a request
+            return
+        if adopted:
+            with self._stats_lock:
+                self._prefix["adopted_pages"] += adopted
 
     # ------------------------------------------------------------ prefill
 
@@ -332,13 +548,23 @@ class ContinuousScheduler:
         chunk = jnp.asarray(slot.ids[start:start + C])
         length_after = min(start + C, self.plan.prompt_region)
         last_index = max(0, min(slot.plen - 1 - start, C - 1))
-        caches, first = self.runtime.prefill_chunk(
-            self.backend.params, self.caches,
-            jnp.asarray(idx, jnp.int32), chunk,
-            jnp.asarray(start, jnp.int32),
-            jnp.asarray(length_after, jnp.int32),
-            jnp.asarray(last_index, jnp.int32),
-        )
+        if self.paged:
+            caches, first = self.runtime.prefill_chunk(
+                self.backend.params, self.caches,
+                jnp.asarray(self._table[idx]),
+                jnp.asarray(idx, jnp.int32), chunk,
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(length_after, jnp.int32),
+                jnp.asarray(last_index, jnp.int32),
+            )
+        else:
+            caches, first = self.runtime.prefill_chunk(
+                self.backend.params, self.caches,
+                jnp.asarray(idx, jnp.int32), chunk,
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(length_after, jnp.int32),
+                jnp.asarray(last_index, jnp.int32),
+            )
         return caches, first, is_last
 
     def _prefill_tick(self) -> bool:
@@ -377,6 +603,8 @@ class ContinuousScheduler:
             firsts = jax.device_get([f for _, _, f in finishing])
             for (idx, slot, _), first in zip(finishing, firsts):
                 slot.next_chunk = -1
+                if self.paged and self._radix is not None:
+                    self._adopt(slot)
                 slot.t_first = time.monotonic()
                 ttft = slot.t_first - slot.req.t_enqueue
                 self._ttft.observe(ttft)
@@ -398,6 +626,12 @@ class ContinuousScheduler:
                     active=int(active.sum()))
         import jax.numpy as jnp
 
+        if self.paged:
+            return self.runtime.decode_step(
+                self.backend.params, self.caches, jnp.asarray(self._table),
+                jnp.asarray(tokens), jnp.asarray(plens), jnp.asarray(steps),
+                jnp.asarray(budgets), jnp.asarray(done), jnp.asarray(active),
+            )
         return self.runtime.decode_step(
             self.backend.params, self.caches,
             jnp.asarray(tokens), jnp.asarray(plens), jnp.asarray(steps),
@@ -515,13 +749,39 @@ class ContinuousScheduler:
         paths pass ``zero=True`` to hard-zero a poisoned slot's rows via
         the ``slots.free`` program anyway: after a fault nothing about
         the slot's contents is trusted, including the invariants above.
+
+        Paged: completion additionally unpins the slot's pages (shared
+        pages stay resident for the radix tree; exclusively-owned pages
+        return to the free list) and points the table row back at the
+        trash page.  The failure path hard-zeroes only pages the slot
+        owned exclusively — shared/tree pages hold prompt KV written by
+        prefill dispatches that *succeeded*, and decode never writes
+        below ``prompt_region``.
         """
         import jax.numpy as jnp
 
         mask = np.zeros(self.plan.n_slots, bool)
+        released: List[int] = []
         for i in indices:
             mask[i] = True
+            slot = self._slots[i]
+            if self.paged and slot is not None and slot.pages is not None:
+                released.extend(slot.pages)
+                self._table[i] = self.plan.trash_page
             self._slots[i] = None
+        if self.paged:
+            pool = self._pool
+            for phys in released:
+                pool.unpin(phys)
+            if zero:
+                page_mask = np.zeros(self.plan.n_pages + 1, bool)
+                for phys in released:
+                    if pool.slot_refs[phys] == 0 and not pool.in_tree[phys]:
+                        page_mask[phys] = True
+                self.caches = self.runtime.free_pages(
+                    self.caches, jnp.asarray(page_mask), jnp.asarray(mask)
+                )
+            return
         if zero:
             self.caches = self.runtime.free_slots(
                 self.caches, jnp.asarray(mask)
@@ -543,6 +803,8 @@ class ContinuousScheduler:
         tel.gauge("serving.decode.free_slots",
                   self.plan.n_slots - self._occupied())
         tel.gauge("serving.decode.prefill_backlog", backlog)
+        if self.paged:
+            tel.gauge("serving.decode.pages_free", self._pool.free_count)
 
     def stats(self) -> Dict[str, Any]:
         """JSON-able snapshot for the ``stats`` control op, the manifest's
@@ -578,5 +840,39 @@ class ContinuousScheduler:
             slot_occupancy_hist=occ,
             compiled_variants=self.runtime.compiled_variants(),
             warmup=self._warmup_record,
+            kv_backend="paged" if self.paged else "slots",
         )
+        if self.paged:
+            plan = self.plan
+            with self._stats_lock:
+                prefix = dict(self._prefix)
+            lookups = prefix["lookups"]
+            hits = prefix["hits"]
+            page_bytes = self.runtime.page_bytes()
+            prefix.update(
+                enabled=self._radix is not None,
+                misses=lookups - hits,
+                hit_rate=round(hits / lookups, 4) if lookups else None,
+                bytes_saved=(
+                    prefix["tokens_shared"] * self.runtime.kv_token_bytes()
+                ),
+                tree_pages=(
+                    self._radix.page_count() if self._radix is not None else 0
+                ),
+                pages_free=self._pool.free_count,
+                # Private HBM footprint one admitted sequence actually
+                # cost, vs the unshared pages_per_slot * page_bytes.
+                hbm_bytes_per_seq=(
+                    round(prefix["fresh_pages"] * page_bytes / lookups)
+                    if lookups else None
+                ),
+                hbm_bytes_per_seq_unshared=plan.pages_per_slot * page_bytes,
+            )
+            out.update(
+                page_size=plan.page_size,
+                kv_pages=plan.n_pages,
+                pages_per_slot=plan.pages_per_slot,
+                page_bytes=page_bytes,
+                prefix_cache=prefix,
+            )
         return out

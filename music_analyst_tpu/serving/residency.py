@@ -149,10 +149,13 @@ class ModelResidency:
         return record
 
     def warmup_decode(self, scheduler) -> Dict[str, Any]:
-        """Compile the continuous-decode slot programs before the first
+        """Compile the continuous-decode programs before the first
         ``generate`` request lands (the decode analogue of :meth:`warmup`:
-        one dummy prefill chunk + decode dispatch + free — after this the
-        runtime's zero-retrace contract holds for the server lifetime)."""
+        dummy prefill + decode dispatch + free — after this the runtime's
+        zero-retrace contract holds for the server lifetime).  The paged
+        runtime walks a ladder of shifted page-table rows so page-gather
+        indices are exercised as traced operands, not baked constants:
+        the same four programs must serve every later table permutation."""
         tel = get_telemetry()
         with tel.span("serve.warmup_decode"):
             record = scheduler.warmup()

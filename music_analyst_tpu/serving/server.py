@@ -381,6 +381,8 @@ def run_server(
     slots: Optional[int] = None,
     prefill_chunk: Optional[int] = None,
     max_new_tokens: int = 16,
+    page_size: Optional[int] = None,
+    kv_pages: Optional[int] = None,
 ) -> int:
     """The ``serve`` subcommand: load, warm, then serve until drained.
 
@@ -426,6 +428,8 @@ def run_server(
                 prefill_chunk=prefill_chunk,
                 max_new_tokens=max_new_tokens,
                 max_queue=max_queue,
+                page_size=page_size,
+                kv_pages=kv_pages,
             )
             if warmup:
                 record = residency.warmup_decode(decode)

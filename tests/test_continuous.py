@@ -13,7 +13,11 @@ Contract families (ISSUE 10):
   finish; a persistent decode fault fails the in-flight requests with
   structured errors and the scheduler keeps serving; a stalled decode
   dispatch trips the watchdog with taxonomy ``decode_stall``; zero
-  retraces of the three compiled programs across a whole workload.
+  retraces of the fixed compiled programs across a whole workload.
+
+Paged-cache-specific contracts (page pool, radix tree, prefix sharing)
+live in tests/test_kv_pages.py; this file exercises the default (paged)
+backend through the same scheduler API it always had.
 """
 
 import json
@@ -385,9 +389,18 @@ def test_ttft_tpot_quantiles_populated(clf):
 
 
 def test_decode_warmup_compiles_before_first_request(clf):
+    # Default backend is the paged cache: four fixed programs (prefill,
+    # decode, free, copy-on-write).  page_size=0 pins PR 10's monolithic
+    # slot cache and its three.
     sched = _scheduler(clf, n_slots=2)
     record = sched.warmup()
-    assert record["programs"] == 3 and record["seconds"] > 0
+    assert record["kv_backend"] == "paged"
+    assert record["programs"] == 4 and record["seconds"] > 0
     variants = sched.runtime.compiled_variants()
     _run(sched, PROMPTS[:2])
     assert sched.runtime.compiled_variants() == variants
+
+    mono = _scheduler(clf, n_slots=2, page_size=0)
+    record = mono.warmup()
+    assert record["kv_backend"] == "slots"
+    assert record["programs"] == 3

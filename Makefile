@@ -5,6 +5,51 @@ PY ?= python
 
 .PHONY: smoke test native
 
+# Router self-check body (exported below; the smoke recipe runs it with
+# $(PY) -c "$$ROUTER_SELFCHECK" <telemetry-dir>): start 2 mock replica
+# workers behind the ReplicaRouter, SIGKILL one mid-load, and assert
+# every admitted request is answered or structurally shed AND the run
+# manifest's serving.router section records the health transition.
+define ROUTER_SELFCHECK
+import json, os, signal, sys, tempfile
+from music_analyst_tpu.telemetry import configure, get_telemetry
+from music_analyst_tpu.serving.router import ReplicaRouter, spawn_replicas
+from music_analyst_tpu.serving.server import SentimentServer
+
+out = sys.argv[1]
+configure(enabled=True, directory=out)
+tel = get_telemetry()
+with tel.run_scope("serve", None):
+    with tempfile.TemporaryDirectory() as base:
+        handles = spawn_replicas(2, base, model="mock", mock=True,
+                                 warmup=False)
+        router = ReplicaRouter(handles, poll_interval_s=0.1).start()
+        server = SentimentServer(router, mode="unix", router=router)
+        reqs = [router.submit(i, "sentiment", "happy %d" % i)
+                for i in range(4)]
+        os.kill(handles[0].proc.pid, signal.SIGKILL)
+        reqs += [router.submit(4 + i, "sentiment", "gray %d" % i)
+                 for i in range(4)]
+        for r in reqs:
+            assert r.wait(60), "request %s never settled" % r.id
+        ok = sum(1 for r in reqs if r.response.get("ok"))
+        shed = sum(1 for r in reqs if not r.response.get("ok")
+                   and r.response["error"]["kind"] in
+                   ("queue_full", "replica_lost", "draining"))
+        assert ok + shed == len(reqs), [r.response for r in reqs]
+        stats = router.stats()
+        assert stats["health_transitions"], "no health transition"
+        router.drain()
+manifest = json.load(open(os.path.join(out, "run_manifest.json")))
+rt = manifest["serving"]["router"]
+assert rt["health_transitions"], rt
+assert rt["requeued"] >= 0 and rt["replica_count"] == 2, rt
+print("router self-check ok:", ok, "answered,", shed, "shed,",
+      rt["requeued"], "requeued,",
+      len(rt["health_transitions"]), "health transition(s)")
+endef
+export ROUTER_SELFCHECK
+
 # Fast observability gate: profiling + telemetry + pipeline +
 # observability + corpus-cache/streaming unit tests, then one
 # smoke-shaped bench.py run through the full parent/child/--baseline
@@ -21,7 +66,7 @@ smoke:
 		tests/test_observability.py tests/test_corpus_cache.py \
 		tests/test_wq_store.py tests/test_serving.py \
 		tests/test_resilience.py tests/test_continuous.py \
-		tests/test_kv_pages.py -q
+		tests/test_kv_pages.py tests/test_router.py -q
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= MUSICAAL_BENCH_SMOKE=1 \
 		$(PY) bench.py --baseline --attempts 1 --deadline 240 \
 		| $(PY) -c "import json,sys; \
@@ -150,6 +195,13 @@ print('smoke ok:', payload['metric'], payload['value'])"
 	      pc['tokens_shared'], 'token(s) shared')" \
 		"$$pctmp/replies.ndjson" "$$pctmp/run_manifest.json" || \
 		{ echo "prefix-cache self-check failed"; exit 1; }
+	# router self-check (body in ROUTER_SELFCHECK above): 2 replicas,
+	# 8 requests, SIGKILL one mid-load — zero admitted requests lost,
+	# health transition in the manifest's serving.router section.
+	routertmp=$$(mktemp -d) && trap 'rm -rf "$$routertmp"' EXIT && \
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+		$(PY) -c "$$ROUTER_SELFCHECK" "$$routertmp" || \
+		{ echo "router self-check failed"; exit 1; }
 	# chaos self-check: analyze with a transient fault injected at the
 	# ingest seam — the run must recover (retry counter in the manifest)
 	# and write a word_counts.csv byte-identical to the clean run (the

@@ -38,6 +38,7 @@ _SUITE_MODULES = (
     "benchmarks.wq_store",
     "benchmarks.serving",
     "benchmarks.continuous",
+    "benchmarks.router",
     "benchmarks.chaos",
 )
 

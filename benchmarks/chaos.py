@@ -171,6 +171,62 @@ def _decode_scenario(n_requests: int) -> dict:
         configure_faults(None)
 
 
+def _router_scenario(n_requests: int) -> dict:
+    """Injected router-dispatch failure: the router's in-place retry
+    absorbs it (no replica marked unhealthy) and every request settles."""
+    from music_analyst_tpu.resilience import (
+        configure_faults,
+        fault_stats,
+        reset_retry_stats,
+        retry_stats,
+    )
+    from music_analyst_tpu.serving.router import ReplicaRouter, spawn_replicas
+
+    reset_retry_stats()
+    configure_faults("router.dispatch:error@1")
+    try:
+        with tempfile.TemporaryDirectory(prefix="chaos_fleet_") as base:
+            handles = spawn_replicas(2, base, model="mock", mock=True,
+                                     warmup=False)
+            router = ReplicaRouter(
+                handles, max_queue=n_requests + 1
+            ).start()
+            try:
+                start = time.perf_counter()
+                reqs = [
+                    router.submit(i, "sentiment", f"chaos row {i}")
+                    for i in range(n_requests)
+                ]
+                for req in reqs:
+                    if not req.wait(timeout=60.0):
+                        raise RuntimeError(
+                            f"request {req.id} never settled"
+                        )
+                elapsed = time.perf_counter() - start
+                stats = router.stats()
+            finally:
+                router.drain()
+        failed = sum(1 for r in reqs if not (r.response or {}).get("ok"))
+        return {
+            "scenario": "router_dispatch_transient",
+            "spec": "router.dispatch:error@1",
+            "requests": n_requests,
+            "failed_requests": failed,
+            "all_answered": failed == 0,
+            "health_transitions": len(stats["health_transitions"]),
+            "requeued": stats["requeued"],
+            "wall_s": round(elapsed, 4),
+            "faults": fault_stats(),
+            "retries": {
+                site: counts
+                for site, counts in retry_stats().items()
+                if counts.get("retries")
+            },
+        }
+    finally:
+        configure_faults(None)
+
+
 def _prefix_lookup_scenario(n_requests: int) -> dict:
     """Corrupted/missed radix lookup (site ``kv_pages.lookup``): every
     faulted admit degrades to a full prefill with zero sharing — the
@@ -318,6 +374,13 @@ def run() -> dict:
             file=sys.stderr,
         )
 
+        router = _router_scenario(32 if smoke() else 256)
+        print(
+            f"[chaos] router: answered={router['all_answered']} "
+            f"wall={router['wall_s']:.3f}s",
+            file=sys.stderr,
+        )
+
         prefix = _prefix_lookup_scenario(4 if smoke() else 16)
         print(
             f"[chaos] prefix_lookup: identical="
@@ -337,6 +400,7 @@ def run() -> dict:
         "scenarios": scenarios,
         "serving": serving,
         "decode": decode,
+        "router": router,
         "prefix_lookup": prefix,
         "all_identical": all(
             s["bytes_identical"] for s in scenarios
@@ -346,5 +410,5 @@ def run() -> dict:
             and (s["degraded"] if s["expect_degraded"] else True)
             for s in scenarios
         ) and serving["all_answered"] and decode["all_answered"]
-        and prefix["all_fell_back"],
+        and router["all_answered"] and prefix["all_fell_back"],
     }

@@ -334,6 +334,16 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                    help="Physical KV pages in the device pool (>= slots; "
                         "0 sizes it to slots*pages_per_slot; default "
                         "$MUSICAAL_SERVE_KV_PAGES or 0)")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="Worker server processes behind the replica "
+                        "router (join-shortest-queue dispatch, "
+                        "health-aware failover; 1 serves in-process; "
+                        "default $MUSICAAL_SERVE_REPLICAS or 1)")
+    p.add_argument("--tp", type=int, default=None,
+                   help="Tensor-parallel width per worker: attention "
+                        "heads + KV cache shard over a tp mesh axis "
+                        "(must divide kv heads; default "
+                        "$MUSICAAL_SERVE_TP or 1)")
     p.add_argument("--no-warmup", action="store_true",
                    help="Skip the startup warmup batches (first request "
                         "pays compile cost)")
@@ -584,7 +594,9 @@ def _dispatch(parser: argparse.ArgumentParser,
                 "(distilbert[-*] or llama[3*])"
             )
         try:
-            return run_server(
+            from music_analyst_tpu.serving.batcher import resolve_replicas
+
+            common = dict(
                 model=args.model,
                 mock=args.mock,
                 weight_quant=(
@@ -603,7 +615,13 @@ def _dispatch(parser: argparse.ArgumentParser,
                 max_new_tokens=args.max_new_tokens,
                 page_size=args.page_size,
                 kv_pages=args.kv_pages,
+                tp=args.tp,
             )
+            if resolve_replicas(args.replicas) > 1:
+                from music_analyst_tpu.serving.router import run_router
+
+                return run_router(replicas=args.replicas, **common)
+            return run_server(**common)
         except ValueError as exc:
             parser.error(str(exc))
 

@@ -340,33 +340,28 @@ def _run_sentiment_impl(
 ) -> SentimentResult:
     os.makedirs(output_dir, exist_ok=True)
     depth = resolve_prefetch_depth(prefetch_depth)
-    if backend is None:
-        # Every built-in backend compiles device programs (the mock path
-        # included — its keyword kernel is jitted), so enable the
-        # persistent cache here rather than in the CLI: library callers
-        # get it too, the pattern run_analysis established.
-        from music_analyst_tpu.utils.cache import (
-            enable_persistent_compilation_cache,
+    if backend is not None and (
+            mesh is not None or _has_buckets(length_buckets)
+            or weight_quant not in (None, "none")):
+        # An injected backend was constructed by the caller; silently
+        # dropping construction-time options here would be a lie.
+        raise ValueError(
+            "mesh=/length_buckets=/weight_quant= configure backend "
+            "construction and cannot be combined with an explicit "
+            "backend="
         )
+    # One owner for the backend lifetime, batch runs included: residency
+    # enables the persistent compile cache before the first build, and
+    # the device-loss recovery below reloads through the same object the
+    # server's failover hook uses (serving/residency.py).
+    from music_analyst_tpu.serving.residency import ModelResidency
 
-        enable_persistent_compilation_cache()
-    if backend is not None:
-        if (mesh is not None or _has_buckets(length_buckets)
-                or weight_quant not in (None, "none")):
-            # An injected backend was constructed by the caller; silently
-            # dropping construction-time options here would be a lie.
-            raise ValueError(
-                "mesh=/length_buckets=/weight_quant= configure backend "
-                "construction and cannot be combined with an explicit "
-                "backend="
-            )
-        clf = backend
-    else:
-        with tel.span("backend_init", model=model, mock=bool(mock)):
-            clf = get_backend(
-                model, mock=mock, mesh=mesh, length_buckets=length_buckets,
-                weight_quant=weight_quant,
-            )
+    residency = ModelResidency(
+        model=model, mock=mock, weight_quant=weight_quant, mesh=mesh,
+        backend=backend, length_buckets=length_buckets,
+    )
+    with tel.span("backend_init", model=model, mock=bool(mock)):
+        clf = residency.acquire()
     tel.annotate(backend=clf.name, batch_size=batch_size, prefetch_depth=depth)
 
     totals_path = os.path.join(output_dir, "sentiment_totals.json")
@@ -406,11 +401,7 @@ def _run_sentiment_impl(
             def _reinit():
                 nonlocal clf
                 if backend is None:
-                    clf = get_backend(
-                        model, mock=mock, mesh=mesh,
-                        length_buckets=length_buckets,
-                        weight_quant=weight_quant,
-                    )
+                    clf = residency.reload()
                 state["handle"] = clf.submit(
                     [text for _, _, text in rows_batch]
                 )

@@ -223,7 +223,7 @@ def run_validation(
     from the same ``MUSICAAL_*_CKPT`` env var a production run uses.
     """
     from music_analyst_tpu.data.csv_io import iter_songs
-    from music_analyst_tpu.engines.sentiment import get_backend
+    from music_analyst_tpu.serving.residency import ModelResidency
 
     family = _family(model)
     checkpoint_path = checkpoint_path or os.environ.get(
@@ -234,9 +234,10 @@ def run_validation(
             f"no checkpoint to validate: set {_ENV_BY_FAMILY[family]} (or "
             "pass checkpoint_path=)"
         )
-    clf = backend if backend is not None else get_backend(
-        model, checkpoint_path=checkpoint_path, weight_quant=weight_quant
-    )
+    clf = ModelResidency(
+        model, backend=backend, weight_quant=weight_quant,
+        checkpoint_path=checkpoint_path,
+    ).acquire()
     if not getattr(clf, "pretrained", False):
         raise RuntimeError(
             "backend did not load the checkpoint — validating random "

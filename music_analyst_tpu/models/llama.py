@@ -942,7 +942,8 @@ class LlamaZeroShotClassifier(ClassifierBackend):
             decode_span=int(decode_span),
         )
         eos_id = getattr(self.tokenizer, "eos_id", ByteTokenizer.EOS)
-        return SlotDecodeRuntime(self.model, self.config, plan, eos_id)
+        return SlotDecodeRuntime(self.model, self.config, plan, eos_id,
+                                 mesh=self.mesh)
 
     def paged_runtime(
         self,
@@ -993,7 +994,8 @@ class LlamaZeroShotClassifier(ClassifierBackend):
             n_pages=n_pages,
         )
         eos_id = getattr(self.tokenizer, "eos_id", ByteTokenizer.EOS)
-        return PagedDecodeRuntime(self.model, self.config, plan, eos_id)
+        return PagedDecodeRuntime(self.model, self.config, plan, eos_id,
+                                  mesh=self.mesh)
 
     def generate_batch_continuous(
         self,

@@ -48,6 +48,8 @@ _ERROR_PATTERNS = (
     ("stage_stall", ("stage stall", "stage_stall")),
     ("serve_stall", ("serve stall", "serve_stall", "serve.dispatch")),
     ("decode_stall", ("decode stall", "decode_stall", "decode.dispatch")),
+    ("router_stall", ("router stall", "router_stall", "router.dispatch",
+                      "replica lost", "replica_lost")),
     ("deadline_expired", ("deadline",)),
     ("harness_killed", ("killed by harness", "sigkill")),
 )
@@ -297,6 +299,7 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     latencies: List[Dict[str, Any]] = []
     resilience_sites: Dict[str, Dict[str, int]] = {}
     degraded_runs: List[Dict[str, Any]] = []
+    router_fleet: List[Dict[str, Any]] = []
 
     def _site(site: str) -> Dict[str, int]:
         return resilience_sites.setdefault(
@@ -362,6 +365,29 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 "site": rec.get("degraded_site"),
                 "reason": rec.get("degraded_reason"),
             })
+        # Scale-out serving: per-replica rollup of the manifest's
+        # serving.router section (serving/router.py stats()).
+        router = (rec.get("serving") or {}).get("router")
+        if router:
+            router_fleet.append({
+                "label": rec["label"],
+                "replica_count": router.get("replica_count"),
+                "healthy_count": router.get("healthy_count"),
+                "dispatched": router.get("dispatched"),
+                "requeued": router.get("requeued"),
+                "shed": router.get("shed"),
+                "health_transitions": len(
+                    router.get("health_transitions") or []
+                ),
+                "replicas": {
+                    name: {
+                        "dispatched": snap.get("dispatched"),
+                        "requeues": snap.get("requeues"),
+                        "health": snap.get("health"),
+                    }
+                    for name, snap in (router.get("replicas") or {}).items()
+                },
+            })
     newest = records[-1] if records else None
     return {
         "schema": 1,
@@ -377,6 +403,7 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "latency_quantiles": latencies,
         "resilience": dict(sorted(resilience_sites.items())),
         "degraded_runs": degraded_runs,
+        "router_fleet": router_fleet,
         "newest": {
             "label": newest["label"],
             "ok": newest["ok"],
@@ -438,6 +465,22 @@ def render_report(report: Dict[str, Any]) -> List[str]:
                 f"  {site.ljust(width)}  {c['trips']} / {c['retries']} / "
                 f"{c['recoveries']} / {c['gave_up']} / {c['failovers']}"
             )
+    if report.get("router_fleet"):
+        lines.append(
+            "router fleet (per replica: dispatched / requeues / health):"
+        )
+        for fleet in report["router_fleet"]:
+            lines.append(
+                f"  {fleet['label']}: {fleet['replica_count']} replica(s), "
+                f"{fleet['dispatched']} dispatched, "
+                f"{fleet['requeued']} requeued, "
+                f"{fleet['health_transitions']} health transition(s)"
+            )
+            for name, snap in (fleet["replicas"] or {}).items():
+                lines.append(
+                    f"    {name}: {snap['dispatched']} / "
+                    f"{snap['requeues']} / {snap['health']}"
+                )
     for run in report.get("degraded_runs") or []:
         lines.append(
             f"  DEGRADED {run['label']}: {run['site']} ({run['reason']})"

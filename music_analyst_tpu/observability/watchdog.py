@@ -53,6 +53,7 @@ TAXONOMY: Dict[str, str] = {
     "host": "host_stall",
     "serve": "serve_stall",
     "decode": "decode_stall",
+    "router": "router_stall",
 }
 
 

@@ -151,11 +151,17 @@ class PagedDecodeRuntime:
     eviction, slot reuse) never retraces.
     """
 
-    def __init__(self, model, config, plan: PagePlan, eos_id: int) -> None:
+    def __init__(self, model, config, plan: PagePlan, eos_id: int,
+                 mesh=None) -> None:
         self.model = model
         self.config = config
         self.plan = plan
         self.eos_id = int(eos_id)
+        # Mesh-aware mode (see SlotDecodeRuntime): the page pool's head
+        # axis shards over tp per DECODE_KV_RULES; the page table stays a
+        # replicated traced operand, so gather/scatter indices are shared
+        # by every chip and only head-local bytes move.
+        self.mesh = mesh
         if plan.max_total > config.max_seq_len:
             raise ValueError(
                 f"prompt_region + max_new ({plan.max_total}) exceeds the "
@@ -360,7 +366,7 @@ class PagedDecodeRuntime:
         head_dim = cfg.dim // cfg.n_heads
         plan = self.plan
         shape = (plan.n_pages + 1, plan.page_size, cfg.n_kv_heads, head_dim)
-        return [
+        caches = [
             KVCache(
                 keys=jnp.zeros(shape, dtype),
                 values=jnp.zeros(shape, dtype),
@@ -368,6 +374,11 @@ class PagedDecodeRuntime:
             )
             for _ in range(cfg.n_layers)
         ]
+        if self.mesh is not None:
+            from music_analyst_tpu.parallel.sharding import shard_kv_caches
+
+            caches = shard_kv_caches(caches, self.mesh, cfg.n_kv_heads)
+        return caches
 
     def kv_token_bytes(self, dtype=jnp.bfloat16) -> int:
         """HBM bytes one cached token costs across all layers (K + V)."""

@@ -96,11 +96,18 @@ class SlotDecodeRuntime:
     through without retracing.
     """
 
-    def __init__(self, model, config, plan: SlotPlan, eos_id: int) -> None:
+    def __init__(self, model, config, plan: SlotPlan, eos_id: int,
+                 mesh=None) -> None:
         self.model = model
         self.config = config
         self.plan = plan
         self.eos_id = int(eos_id)
+        # Mesh-aware mode: params arrive already placed by the classifier's
+        # TP_RULES and the cache is placed by DECODE_KV_RULES (head axis
+        # over tp), so the three programs lower once per geometry with
+        # GSPMD-propagated shardings — same zero-retrace discipline, same
+        # bytes (tp just splits the head loop the reductions never cross).
+        self.mesh = mesh
         if plan.max_total > config.max_seq_len:
             raise ValueError(
                 f"prompt_region + max_new ({plan.max_total}) exceeds the "
@@ -236,7 +243,7 @@ class SlotDecodeRuntime:
         cfg = self.config
         head_dim = cfg.dim // cfg.n_heads
         plan = self.plan
-        return [
+        caches = [
             KVCache(
                 keys=jnp.zeros(
                     (plan.n_slots, plan.max_total, cfg.n_kv_heads, head_dim),
@@ -250,6 +257,11 @@ class SlotDecodeRuntime:
             )
             for _ in range(cfg.n_layers)
         ]
+        if self.mesh is not None:
+            from music_analyst_tpu.parallel.sharding import shard_kv_caches
+
+            caches = shard_kv_caches(caches, self.mesh, cfg.n_kv_heads)
+        return caches
 
     def compiled_variants(self) -> int:
         """Total compiled-program count across the three programs — the

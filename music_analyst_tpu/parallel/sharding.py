@@ -47,6 +47,64 @@ TP_RULES: List[Tuple[str, P]] = [
 ]
 
 
+# Decode-runtime KV layout: logical axis name → mesh axis (the
+# ``DEFAULT_RULES`` dict shape of megatron-style jax stacks).  Both KV
+# layouts the serving stack compiles — the monolithic slot cache
+# ``[n_slots, max_total, n_kv_heads, head_dim]`` and the paged pool
+# ``[n_pages + 1, page_size, n_kv_heads, head_dim]`` — put the KV-head
+# axis third, matching the q/k/v projections' head sharding above, so
+# per-head attention never crosses the tp axis and the only decode-path
+# collective stays the o_proj all-reduce the param rules already imply.
+DECODE_KV_RULES = {
+    "slots": None,      # slot / physical-page axis: every chip sees all slots
+    "pages": None,
+    "tokens": None,     # sequence axis: attention reduces over it per head
+    "kv_heads": "tp",   # shard heads with the projections that feed them
+    "head_dim": None,
+    "lengths": None,    # per-slot write offsets: tiny, replicated
+}
+
+
+def kv_cache_spec(mesh: Mesh, n_kv_heads: int) -> Tuple[P, P]:
+    """(keys/values spec, length spec) for a decode KV cache on ``mesh``.
+
+    The head axis shards over ``tp`` only when the mesh has a tp axis
+    that divides ``n_kv_heads`` — otherwise the cache replicates, so a
+    dp-only mesh (or a tp size the head count can't split) degrades to
+    the single-chip layout instead of failing placement.
+    """
+    head_axis = DECODE_KV_RULES["kv_heads"]
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    tp = axis_sizes.get(head_axis, 1)
+    if tp > 1 and n_kv_heads % tp == 0:
+        kv = P(None, None, head_axis, None)
+    else:
+        kv = P()
+    return kv, P()
+
+
+def shard_kv_caches(caches, mesh: Mesh, n_kv_heads: int):
+    """Place freshly-initialized decode KV caches on ``mesh`` per
+    :data:`DECODE_KV_RULES` (keys/values head-sharded over tp, lengths
+    replicated).  ``caches`` is the per-layer list of ``KVCache`` the
+    runtimes' ``init_caches`` builds; the dataclass is rebuilt leaf by
+    leaf so donated-buffer identity is preserved elsewhere."""
+    import dataclasses
+
+    kv_spec, len_spec = kv_cache_spec(mesh, n_kv_heads)
+    kv_sh = NamedSharding(mesh, kv_spec)
+    len_sh = NamedSharding(mesh, len_spec)
+    return [
+        dataclasses.replace(
+            c,
+            keys=jax.device_put(c.keys, kv_sh),
+            values=jax.device_put(c.values, kv_sh),
+            length=jax.device_put(c.length, len_sh),
+        )
+        for c in caches
+    ]
+
+
 def spec_for_path(path: str, rules=None) -> P:
     for pattern, spec in rules or TP_RULES:
         if re.match(pattern, path):

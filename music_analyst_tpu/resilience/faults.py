@@ -57,6 +57,7 @@ SITES = frozenset(
         "decode.step",
         "checkpoint.load",
         "kv_pages.lookup",
+        "router.dispatch",
     }
 )
 

@@ -57,6 +57,14 @@ DEFAULT_PREFILL_CHUNK = 64
 # auto: n_slots * pages_per_slot, i.e. no oversubscription).
 DEFAULT_PAGE_SIZE = 16
 DEFAULT_KV_PAGES = 0
+# Scale-out serving (serving/router.py): replica worker count behind the
+# router, and tensor-parallel width within each worker's decode runtime.
+DEFAULT_REPLICAS = 1
+DEFAULT_TP = 1
+# Bounds on the ``retry_after_ms`` hint a queue_full shed carries: never
+# tell a client to come back sooner than one flush deadline, never park
+# it for more than half a minute on a stale rate estimate.
+_RETRY_AFTER_CAP_MS = 30_000.0
 
 # Occupancy lives in (0, 1]; the latency-shaped default buckets would
 # put every observation in one bin.
@@ -148,6 +156,24 @@ def resolve_page_size(value: Any = None) -> int:
     return page
 
 
+def resolve_replicas(value: Any = None) -> int:
+    """Replica worker count (``--replicas`` /
+    ``$MUSICAAL_SERVE_REPLICAS``).  1 serves in-process; > 1 puts the
+    replica router (``serving/router.py``) in front of that many worker
+    processes."""
+    return int(_resolve(value, "MUSICAAL_SERVE_REPLICAS",
+                        DEFAULT_REPLICAS, integer=True, minimum=1))
+
+
+def resolve_tp(value: Any = None) -> int:
+    """Tensor-parallel width for the decode runtime (``--tp`` /
+    ``$MUSICAAL_SERVE_TP``).  1 keeps the single-chip layout; > 1 shards
+    attention heads and the KV cache over a ``tp`` mesh axis
+    (``parallel/sharding.DECODE_KV_RULES``)."""
+    return int(_resolve(value, "MUSICAAL_SERVE_TP",
+                        DEFAULT_TP, integer=True, minimum=1))
+
+
 def resolve_kv_pages(value: Any = None, n_slots: Optional[int] = None) -> int:
     """KV pool size in pages (``--kv-pages`` /
     ``$MUSICAAL_SERVE_KV_PAGES``).
@@ -201,12 +227,14 @@ class ServeRequest:
         out.update(fields)
         self.complete(out)
 
-    def fail(self, kind: str, detail: str = "") -> None:
+    def fail(self, kind: str, detail: str = "", **extra: Any) -> None:
+        error: Dict[str, Any] = {"kind": kind, "detail": detail}
+        error.update(extra)
         self.complete({
             "id": self.id,
             "ok": False,
             "op": self.op,
-            "error": {"kind": kind, "detail": detail},
+            "error": error,
         })
 
     @property
@@ -256,12 +284,16 @@ class DynamicBatcher:
         self._latency = Histogram(_LATENCY_BUCKETS)
         self._occupancy = Histogram(_OCCUPANCY_BUCKETS)
         self._stats_lock = threading.Lock()
-        self._stats: Dict[str, int] = {
+        self._stats: Dict[str, Any] = {
             "admitted": 0, "shed": 0, "completed": 0, "failed": 0,
             "bad_request": 0, "batches": 0, "rows": 0, "padded_rows": 0,
             "queue_depth_max": 0, "isolation_retries": 0,
-            "failover_reloads": 0,
+            "failover_reloads": 0, "dedup_folded": 0,
+            "retry_after_ms_last": None,
         }
+        # EWMA of observed flush throughput (rows/s) — feeds the
+        # ``retry_after_ms`` hint a queue_full shed carries.
+        self._flush_rate = 0.0
 
     # ----------------------------------------------------------- lifecycle
 
@@ -314,12 +346,16 @@ class DynamicBatcher:
                 return req
             depth = sum(len(q) for q in self._queues.values())
             if depth >= self.max_queue:
+                hint_ms = self.retry_after_ms(depth)
                 req.fail(
                     "queue_full",
                     f"admission queue full ({depth}/{self.max_queue}); "
-                    "retry with backoff",
+                    f"retry after {hint_ms:.0f} ms",
+                    retry_after_ms=hint_ms,
                 )
-                self._bump(shed=1)
+                with self._stats_lock:
+                    self._stats["shed"] += 1
+                    self._stats["retry_after_ms_last"] = hint_ms
                 tel.count("serving.shed")
                 return req
             self._queues[op].append(req)
@@ -337,6 +373,24 @@ class DynamicBatcher:
         with self._stats_lock:
             for key, n in deltas.items():
                 self._stats[key] += n
+
+    def retry_after_ms(self, depth: Optional[int] = None) -> float:
+        """Backoff hint for a shed client: the estimated time to drain the
+        current queue at the observed flush rate (EWMA of rows/s over
+        completed batches), floored at one flush deadline and capped so a
+        stale estimate can't park clients for minutes.  Before the first
+        flush there is no rate yet — fall back to the number of full
+        batches queued times the flush deadline."""
+        if depth is None:
+            with self._cond:
+                depth = sum(len(q) for q in self._queues.values())
+        floor_ms = max(self.max_wait_ms, 1.0)
+        rate = self._flush_rate
+        if rate > 0.0:
+            hint = depth / rate * 1000.0
+        else:
+            hint = (depth / self.max_batch) * floor_ms
+        return round(min(max(hint, floor_ms), _RETRY_AFTER_CAP_MS), 3)
 
     # -------------------------------------------------------------- worker
 
@@ -411,22 +465,39 @@ class DynamicBatcher:
     ) -> None:
         tel = get_telemetry()
         n = len(batch)
-        padded = round_pow2(n, 1)
-        texts = [r.text for r in batch] + [""] * (padded - n)
+        # In-batch dedup: identical request texts occupy ONE device row;
+        # the row's result fans out to every requester.  Ops are pure
+        # batch functions over texts (same text → same payload), so this
+        # is invisible on the wire and free occupancy when a burst repeats
+        # itself (the same song submitted by many clients at once).
+        row_of: Dict[str, int] = {}
+        rows: List[int] = []
+        uniques: List[str] = []
+        for req in batch:
+            idx = row_of.get(req.text)
+            if idx is None:
+                idx = len(uniques)
+                row_of[req.text] = idx
+                uniques.append(req.text)
+            rows.append(idx)
+        n_unique = len(uniques)
+        padded = round_pow2(n_unique, 1)
+        texts = uniques + [""] * (padded - n_unique)
         t0 = time.perf_counter()
         try:
             # The dispatch edge is where a wedged device/tunnel would hang
             # a resident server silently — the watchdog classifies that as
             # serve_stall instead of a mute socket.
             with watchdog.watch("serve.dispatch", kind="serve"):
-                with tel.span("serve.batch", op=op, rows=n, padded=padded):
+                with tel.span("serve.batch", op=op, rows=n_unique,
+                              padded=padded):
                     results = self._retry.call(
                         self._run_op, op, texts, site="serving.dispatch"
-                    )[:n]
-            if len(results) != n:
+                    )[:n_unique]
+            if len(results) != n_unique:
                 raise RuntimeError(
                     f"op {op!r} returned {len(results)} results for "
-                    f"{n} rows"
+                    f"{n_unique} rows"
                 )
         except Exception as exc:  # noqa: BLE001 — isolation boundary
             # Classified backend loss: reload through the failover hook
@@ -451,26 +522,35 @@ class DynamicBatcher:
             return
         batch_s = time.perf_counter() - t0
         tel.observe("serving.batch_seconds", batch_s)
-        occupancy = n / padded
+        occupancy = n_unique / padded
         now = time.monotonic()
         with self._stats_lock:
             self._stats["batches"] += 1
-            self._stats["rows"] += n
+            self._stats["rows"] += n_unique
             self._stats["padded_rows"] += padded
             self._stats["completed"] += n
+            self._stats["dedup_folded"] += n - n_unique
             self._occupancy.observe(occupancy)
             for req in batch:
                 self._latency.observe(now - req.t_enqueue)
+            # Flush-rate EWMA feeding retry_after_ms: requests retired per
+            # wall second, smoothed so one anomalous batch can't swing the
+            # backoff hint an order of magnitude.
+            inst = n / max(batch_s, 1e-6)
+            self._flush_rate = (
+                inst if self._flush_rate == 0.0
+                else 0.8 * self._flush_rate + 0.2 * inst
+            )
         tel.observe(
             "serving.batch_occupancy", occupancy,
             buckets=_OCCUPANCY_BUCKETS,
         )
-        for req, payload in zip(batch, results):
+        for req, row in zip(batch, rows):
             tel.observe(
                 "serving.request_seconds", now - req.t_enqueue,
                 buckets=_LATENCY_BUCKETS,
             )
-            req.succeed(**payload)
+            req.succeed(**results[row])
         tel.count("serving.completed", n)
 
     # ------------------------------------------------------------ readouts
@@ -486,11 +566,18 @@ class DynamicBatcher:
             )
             latency = self._latency.as_dict()
             occ = self._occupancy.as_dict()
+            flush_rate = self._flush_rate
+        dedup_factor = (
+            (out["rows"] + out["dedup_folded"]) / out["rows"]
+            if out["rows"] else 1.0
+        )
         out.update(
             max_batch=self.max_batch,
             max_wait_ms=self.max_wait_ms,
             max_queue=self.max_queue,
             occupancy=round(occupancy, 4) if occupancy is not None else None,
+            dedup_factor=round(dedup_factor, 4),
+            flush_rate_rows_s=round(flush_rate, 3),
             latency=latency,
             batch_occupancy_hist=occ,
         )

@@ -20,6 +20,13 @@ amortize it over a whole dataset; a server amortizes it over its
 
 Per-backend compile/warmup state is tracked in :meth:`snapshot` and
 lands in the run manifest's ``serving.residency`` section.
+
+This object is the single owner of a resident backend *everywhere*, not
+just under the server: the batch sentiment engine and the weight
+validator acquire through it too, so backend construction (persistent
+compile cache, mesh placement, weight-quant streaming, length buckets)
+is written once and reload-on-poisoned-device is one code path
+(:meth:`reload`) whichever surface hit the failure.
 """
 
 from __future__ import annotations
@@ -53,11 +60,15 @@ class ModelResidency:
         weight_quant: Optional[str] = None,
         mesh=None,
         backend=None,
+        **backend_kwargs: Any,
     ) -> None:
         self.model = model
         self.mock = mock
         self.weight_quant = weight_quant
         self.mesh = mesh
+        # Extra get_backend() options (length_buckets, checkpoint_path, …)
+        # pinned at construction so a reload rebuilds the same backend.
+        self.backend_kwargs = backend_kwargs
         self._backend = backend  # injected (tests) — skips loading
         self._lock = threading.Lock()
         self._state: Dict[str, Any] = {
@@ -93,6 +104,7 @@ class ModelResidency:
                     mock=self.mock,
                     mesh=self.mesh,
                     weight_quant=self.weight_quant,
+                    **self.backend_kwargs,
                 )
             load_s = time.perf_counter() - t0
             self._state.update(

@@ -1,0 +1,865 @@
+"""Replica router: one dispatch point in front of N worker servers.
+
+One resident server (``serving/server.py``) is one process on one
+backend; the scale-out shape is N such workers — each a full
+``SentimentServer`` listening on its own unix socket, typically spawned
+by :func:`spawn_replicas` — behind this router:
+
+* **join-shortest-queue dispatch** — each admitted request goes to the
+  healthy replica with the fewest router-side in-flight requests, tie
+  broken by the queue depth its last polled ``stats`` reply reported;
+* **health** — a poll thread pings every replica's ``stats`` op; a
+  transport failure, worker death, or dispatch failure classified by the
+  watchdog taxonomy (``tunnel_dead`` / ``decode_stall``) marks the
+  replica unhealthy, its undelivered in-flight requests are *requeued*
+  and re-dispatched to the survivors (``resilience/failover.py``
+  classification + the shared :class:`RetryPolicy` at the new
+  ``router.dispatch`` fault site), and the transition is recorded for
+  the run manifest's ``serving.router`` section;
+* **zero loss** — every admitted request either settles with a replica's
+  answer (possibly after re-dispatch) or fails with a structured error
+  (``queue_full`` with a ``retry_after_ms`` hint, ``replica_lost`` when
+  no healthy replica remains); nothing is dropped silently.  Sentiment
+  and wordcount ops are pure functions of their text, so re-dispatching
+  a request whose first answer died with its worker is idempotent;
+* **graceful fleet drain** — SIGTERM (installed by :func:`run_router`)
+  stops admission, settles everything in flight, then SIGTERMs each
+  worker so *their* graceful-drain contract runs, escalating to SIGKILL
+  only for stragglers.
+
+The router speaks the same ``ndjson/v1`` wire protocol downstream that
+it serves upstream; request ids are rewritten to router-scoped wire ids
+on the way down and restored on the way up, so colliding client ids
+across connections cannot cross-talk.  The router quacks like a
+``DynamicBatcher`` (``submit``/``drain``/``stats``), so the front end is
+a plain ``SentimentServer`` with this object in the batcher seat.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from music_analyst_tpu.observability import watchdog
+from music_analyst_tpu.resilience.failover import should_failover
+from music_analyst_tpu.resilience.faults import fault_point
+from music_analyst_tpu.resilience.policy import RetryPolicy, classify_retryable
+from music_analyst_tpu.serving.batcher import (
+    _RETRY_AFTER_CAP_MS,
+    ServeRequest,
+    resolve_max_queue,
+    resolve_replicas,
+    resolve_tp,
+)
+from music_analyst_tpu.telemetry import get_telemetry
+
+# Ops the router will forward; anything else is a bad_request at the edge
+# (control ops never reach here — the front server answers them itself).
+_FORWARD_OPS = ("sentiment", "wordcount", "generate")
+
+# How long to wait for a spawned worker's socket + first ping.  Workers
+# compile their warmup ladder before listening, so this is generous; a
+# worker that cannot come up inside it is killed and reported.
+_SPAWN_TIMEOUT_S = 120.0
+
+
+_LAST_ROUTER: Optional["ReplicaRouter"] = None
+
+
+def router_stats() -> Dict[str, Any]:
+    """Stats of the most recent router in this process ({} if none)."""
+    router = _LAST_ROUTER
+    return router.stats() if router is not None else {}
+
+
+def _is_transport(exc: BaseException) -> bool:
+    """Failures that indict the replica's transport, not the request."""
+    return isinstance(exc, (OSError, EOFError))
+
+
+class ReplicaHandle:
+    """One worker server: its process, socket, and in-flight table.
+
+    ``proc`` is None for externally-managed workers (tests connect the
+    router to servers they started themselves); health tracking and
+    requeue work the same either way.
+    """
+
+    def __init__(self, name: str, socket_path: str,
+                 proc: Optional[subprocess.Popen] = None) -> None:
+        self.name = name
+        self.socket_path = socket_path
+        self.proc = proc
+        self.health = "starting"
+        self.dispatched = 0
+        self.requeues = 0
+        self.last_stats: Optional[Dict[str, Any]] = None
+        self._sock = None
+        self._wfile = None
+        self._reader: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        # wire id → (original id, ServeRequest); None req marks a poll.
+        self._pending: Dict[int, Any] = {}
+        self._on_lost = None     # set by the router at adoption
+        self._on_reply = None    # ditto: per-settled-reply bookkeeping
+
+    # ---------------------------------------------------------- lifecycle
+
+    def connect(self, timeout_s: float = _SPAWN_TIMEOUT_S) -> None:
+        """Wait for the worker's socket, connect, and start the reader."""
+        import socket as socketlib
+
+        deadline = time.monotonic() + timeout_s
+        last_exc: Optional[BaseException] = None
+        while time.monotonic() < deadline:
+            if self.proc is not None and self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica {self.name} exited rc={self.proc.returncode} "
+                    "before its socket came up"
+                )
+            if os.path.exists(self.socket_path):
+                sock = socketlib.socket(
+                    socketlib.AF_UNIX, socketlib.SOCK_STREAM
+                )
+                try:
+                    sock.connect(self.socket_path)
+                except OSError as exc:
+                    last_exc = exc
+                    sock.close()
+                else:
+                    self._sock = sock
+                    self._wfile = sock.makefile("w", encoding="utf-8")
+                    self._reader = threading.Thread(
+                        target=self._read_loop,
+                        args=(sock.makefile("r", encoding="utf-8"),),
+                        name=f"router-read-{self.name}",
+                        daemon=True,
+                    )
+                    self._reader.start()
+                    self.health = "healthy"
+                    return
+            time.sleep(0.05)
+        raise RuntimeError(
+            f"replica {self.name} not reachable at {self.socket_path} "
+            f"after {timeout_s:.0f}s"
+            + (f" ({last_exc})" if last_exc else "")
+        )
+
+    def alive(self) -> bool:
+        return self.proc is None or self.proc.poll() is None
+
+    def close(self) -> None:
+        with self._lock:
+            wfile, sock = self._wfile, self._sock
+            self._wfile = self._sock = None
+        for closable in (wfile, sock):
+            try:
+                if closable is not None:
+                    closable.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------- wire
+
+    def send(self, wire_id: int, payload: Dict[str, Any],
+             entry: Any) -> None:
+        """Register ``entry`` under ``wire_id`` and write one request line.
+
+        Registration happens first so a reply can never race its own
+        pending record; on a write failure the record is withdrawn and the
+        transport error propagates to the dispatcher."""
+        with self._lock:
+            wfile = self._wfile
+            if wfile is None:
+                raise ConnectionError(
+                    f"replica {self.name} has no live connection"
+                )
+            self._pending[wire_id] = entry
+            try:
+                wfile.write(json.dumps(payload) + "\n")
+                wfile.flush()
+            except Exception:
+                self._pending.pop(wire_id, None)
+                raise
+
+    def in_flight(self) -> int:
+        with self._lock:
+            return sum(
+                1 for entry in self._pending.values() if entry[1] is not None
+            )
+
+    def take_pending(self) -> List[Any]:
+        """Drain the in-flight table (replica lost): the unanswered
+        requests, for the router to requeue."""
+        with self._lock:
+            entries = [
+                entry for entry in self._pending.values()
+                if entry[1] is not None
+            ]
+            self._pending.clear()
+        return entries
+
+    def _read_loop(self, rfile) -> None:
+        try:
+            for line in rfile:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    payload = json.loads(line)
+                except ValueError:
+                    continue
+                with self._lock:
+                    entry = self._pending.pop(payload.get("id"), None)
+                if entry is None:
+                    continue
+                original_id, req = entry
+                if req is None:  # stats poll reply
+                    self.last_stats = payload.get("stats")
+                    continue
+                payload["id"] = original_id
+                req.complete(payload)
+                on_reply = self._on_reply
+                if on_reply is not None:
+                    on_reply(bool(payload.get("ok")))
+        except (OSError, ValueError):
+            pass
+        finally:
+            on_lost = self._on_lost
+            if on_lost is not None:
+                on_lost(self)
+
+    # ----------------------------------------------------------- teardown
+
+    def terminate(self, grace_s: float = 10.0) -> None:
+        """SIGTERM the worker (its graceful drain), SIGKILL a straggler."""
+        self.close()
+        proc = self.proc
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            proc.terminate()
+            proc.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            try:
+                proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                pass
+        except OSError:
+            pass
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "socket": self.socket_path,
+            "health": self.health,
+            "alive": self.alive(),
+            "dispatched": self.dispatched,
+            "requeues": self.requeues,
+            "in_flight": self.in_flight(),
+            "last_stats": self.last_stats,
+        }
+
+
+class _RouterDecode:
+    """Adapter putting the router in a ``SentimentServer``'s decode seat:
+    ``generate`` requests forward to a replica (whose own scheduler hosts
+    the decode runtime) instead of running in the router process."""
+
+    def __init__(self, router: "ReplicaRouter") -> None:
+        self._router = router
+
+    def submit(self, rid: Any, text: str,
+               max_new_tokens: Optional[int] = None) -> ServeRequest:
+        meta = (
+            {"max_new_tokens": int(max_new_tokens)}
+            if max_new_tokens is not None else {}
+        )
+        return self._router.submit(rid, "generate", text, meta=meta)
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        pass  # the router's own drain covers the fleet
+
+    def stats(self) -> Dict[str, Any]:
+        return {"forwarded": True}
+
+
+class ReplicaRouter:
+    """Join-shortest-queue dispatch with health-aware failover."""
+
+    def __init__(
+        self,
+        replicas: List[ReplicaHandle],
+        max_queue: Optional[int] = None,
+        poll_interval_s: float = 0.25,
+        redispatch_limit: int = 3,
+    ) -> None:
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self.replicas = list(replicas)
+        self.max_queue = resolve_max_queue(max_queue)
+        self.poll_interval_s = float(poll_interval_s)
+        self.redispatch_limit = int(redispatch_limit)
+        self._retry = RetryPolicy(base_s=0.05, cap_s=1.0)
+        self._cond = threading.Condition()
+        self._queue: deque = deque()
+        self._draining = False
+        self._threads: List[threading.Thread] = []
+        self._wire_ids = 0
+        self._stats_lock = threading.Lock()
+        self._stats: Dict[str, Any] = {
+            "admitted": 0, "shed": 0, "completed": 0, "failed": 0,
+            "bad_request": 0, "dispatched": 0, "requeued": 0,
+            "queue_depth_max": 0, "retry_after_ms_last": None,
+        }
+        self._transitions: List[Dict[str, Any]] = []
+        self._started_mono = time.monotonic()
+        self._settle_rate = 0.0
+        self._settle_mark = time.monotonic()
+        for handle in self.replicas:
+            handle._on_lost = self._replica_lost
+            handle._on_reply = self._reply_settled
+        global _LAST_ROUTER
+        _LAST_ROUTER = self
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "ReplicaRouter":
+        if not self._threads:
+            for target, name in (
+                (self._dispatch_loop, "router-dispatch"),
+                (self._poll_loop, "router-poll"),
+            ):
+                thread = threading.Thread(target=target, name=name,
+                                          daemon=True)
+                thread.start()
+                self._threads.append(thread)
+        return self
+
+    def drain(self, timeout: Optional[float] = 30.0) -> None:
+        """Stop admission, settle every queued/in-flight request, then
+        gracefully stop the fleet (each worker runs its own drain)."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        deadline = time.monotonic() + (timeout or 30.0)
+        while time.monotonic() < deadline:
+            with self._cond:
+                queued = len(self._queue)
+            in_flight = sum(h.in_flight() for h in self.replicas)
+            if queued == 0 and in_flight == 0:
+                break
+            time.sleep(0.02)
+        for handle in self.replicas:
+            for req_entry in handle.take_pending():
+                _, req = req_entry
+                if req is not None and not req.done:
+                    req.fail("draining", "router drained before the "
+                                         "replica answered")
+        for handle in self.replicas:
+            handle.terminate()
+        for thread in self._threads:
+            thread.join(timeout=2.0)
+        self._threads = []
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # ----------------------------------------------------------- admission
+
+    def submit(self, rid: Any, op: str, text: str,
+               meta: Optional[Dict[str, Any]] = None) -> ServeRequest:
+        """Admit (or shed) one request; mirrors ``DynamicBatcher.submit``
+        so a ``SentimentServer`` can sit directly in front."""
+        tel = get_telemetry()
+        req = ServeRequest(rid, op, text, meta=meta)
+        if op not in _FORWARD_OPS:
+            req.fail("bad_request",
+                     f"unknown op {op!r}; have: {sorted(_FORWARD_OPS)}")
+            self._bump(bad_request=1)
+            return req
+        with self._cond:
+            if self._draining:
+                req.fail("draining", "router is draining; not admitting")
+                self._bump(shed=1)
+                tel.count("router.shed")
+                return req
+            depth = len(self._queue)
+            if depth >= self.max_queue:
+                hint_ms = self.retry_after_ms(depth)
+                req.fail(
+                    "queue_full",
+                    f"router queue full ({depth}/{self.max_queue}); "
+                    f"retry after {hint_ms:.0f} ms",
+                    retry_after_ms=hint_ms,
+                )
+                with self._stats_lock:
+                    self._stats["shed"] += 1
+                    self._stats["retry_after_ms_last"] = hint_ms
+                tel.count("router.shed")
+                return req
+            self._queue.append(req)
+            depth += 1
+            self._cond.notify_all()
+        with self._stats_lock:
+            self._stats["admitted"] += 1
+            if depth > self._stats["queue_depth_max"]:
+                self._stats["queue_depth_max"] = depth
+        tel.count("router.admitted")
+        tel.gauge("router.queue_depth", depth)
+        return req
+
+    def retry_after_ms(self, depth: Optional[int] = None) -> float:
+        """Backoff hint for a shed client (the batcher's formula over the
+        fleet-wide settle rate)."""
+        if depth is None:
+            with self._cond:
+                depth = len(self._queue)
+        rate = self._settle_rate
+        hint = depth / rate * 1000.0 if rate > 0.0 else 50.0 * max(depth, 1)
+        return round(min(max(hint, 1.0), _RETRY_AFTER_CAP_MS), 3)
+
+    def _bump(self, **deltas: int) -> None:
+        with self._stats_lock:
+            for key, n in deltas.items():
+                self._stats[key] += n
+
+    # ------------------------------------------------------------ dispatch
+
+    def _pick(self, excluded: set) -> Optional[ReplicaHandle]:
+        """Healthy replica with the shortest queue: router-side in-flight
+        first (exact), the replica's last-polled queue depth as the tie
+        break (the ``stats()`` feed)."""
+        best = None
+        best_key = None
+        for handle in self.replicas:
+            if handle.health != "healthy" or handle.name in excluded:
+                continue
+            polled = 0
+            stats = handle.last_stats
+            if isinstance(stats, dict):
+                requests = stats.get("requests", {})
+                polled = int(requests.get("queue_depth_max", 0) or 0)
+            key = (handle.in_flight(), polled)
+            if best_key is None or key < best_key:
+                best, best_key = handle, key
+        return best
+
+    def _wire_payload(self, wire_id: int, req: ServeRequest) -> Dict[str, Any]:
+        payload = {"id": wire_id, "op": req.op, "text": req.text}
+        budget = req.meta.get("max_new_tokens")
+        if budget is not None:
+            payload["max_new_tokens"] = budget
+        return payload
+
+    def _send_once(self, handle: ReplicaHandle, req: ServeRequest) -> None:
+        fault_point("router.dispatch", replica=handle.name, op=req.op)
+        with self._cond:
+            self._wire_ids += 1
+            wire_id = self._wire_ids
+        handle.send(wire_id, self._wire_payload(wire_id, req), (req.id, req))
+
+    def _dispatch_one(self, req: ServeRequest) -> None:
+        tel = get_telemetry()
+        excluded: set = set()
+        while not req.done:
+            handle = self._pick(excluded)
+            if handle is None:
+                req.fail(
+                    "replica_lost",
+                    "no healthy replica available (router_stall); "
+                    "all workers are unhealthy or excluded",
+                )
+                self._bump(failed=1)
+                tel.count("router.replica_lost")
+                return
+            try:
+                # A wedged worker hangs the send/flush edge silently —
+                # the watchdog names that router_stall; transient faults
+                # (injected router.dispatch, a mid-write hiccup) retry in
+                # place against the same replica first.
+                with watchdog.watch("router.dispatch", kind="router"):
+                    self._retry.call(
+                        self._send_once, handle, req,
+                        site="router.dispatch",
+                    )
+            except Exception as exc:  # noqa: BLE001 — failover boundary
+                retryable, kind = classify_retryable(exc)
+                if _is_transport(exc) or should_failover(exc):
+                    # The replica, not the request: mark it, requeue its
+                    # other in-flight work, and re-dispatch here to the
+                    # next-shortest healthy queue.
+                    self._mark_lost(
+                        handle, kind or "tunnel_dead",
+                        f"dispatch failed: {type(exc).__name__}: {exc}",
+                    )
+                    excluded.add(handle.name)
+                    continue
+                req.fail("request_failed",
+                         f"{type(exc).__name__}: {exc}"[:300])
+                self._bump(failed=1)
+                return
+            handle.dispatched += 1
+            self._bump(dispatched=1)
+            tel.count("router.dispatched")
+            return
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue:
+                    if self._draining:
+                        return
+                    self._cond.wait(0.05)
+                req = self._queue.popleft()
+            if req.done:  # shed/settled while queued
+                continue
+            self._dispatch_one(req)
+            watchdog.beat("router.dispatch")
+
+    # -------------------------------------------------------------- health
+
+    def _record_transition(self, handle: ReplicaHandle, new: str,
+                           kind: str, reason: str) -> None:
+        transition = {
+            "replica": handle.name,
+            "from": handle.health,
+            "to": new,
+            "kind": kind,
+            "reason": reason[:200],
+            "t_s": round(time.monotonic() - self._started_mono, 3),
+        }
+        handle.health = new
+        with self._stats_lock:
+            self._transitions.append(transition)
+        tel = get_telemetry()
+        tel.count("router.health_transitions")
+        tel.event("router_health", **transition)
+
+    def _replica_lost(self, handle: ReplicaHandle) -> None:
+        """Reader-thread callback: the replica's connection died."""
+        if self._draining or handle.health in ("unhealthy", "dead"):
+            return
+        self._mark_lost(handle, "tunnel_dead", "connection lost")
+
+    def _mark_lost(self, handle: ReplicaHandle, kind: str,
+                   reason: str) -> None:
+        if handle.health in ("unhealthy", "dead"):
+            return
+        new = "unhealthy" if handle.alive() else "dead"
+        self._record_transition(handle, new, kind, reason)
+        handle.close()
+        pending = handle.take_pending()
+        if not pending:
+            return
+        requeued = 0
+        for original_id, req in pending:
+            if req is None or req.done:
+                continue
+            attempts = req.meta.get("router_attempts", 0) + 1
+            req.meta["router_attempts"] = attempts
+            if attempts > self.redispatch_limit:
+                req.fail(
+                    "replica_lost",
+                    f"replica {handle.name} lost ({kind}) and the request "
+                    f"exceeded {self.redispatch_limit} re-dispatches",
+                )
+                self._bump(failed=1)
+                continue
+            with self._cond:
+                # Head of the queue: a re-dispatched request has already
+                # waited one full replica lifetime.
+                self._queue.appendleft(req)
+                self._cond.notify_all()
+            requeued += 1
+        handle.requeues += requeued
+        self._bump(requeued=requeued)
+        get_telemetry().count("router.requeued", requeued)
+
+    def _poll_loop(self) -> None:
+        """Per-replica ``stats`` polling: feeds the JSQ tie break, acts as
+        a liveness probe, and notices worker death even when no request
+        is in flight to trip on it."""
+        while True:
+            with self._cond:
+                if self._draining:
+                    return
+            for handle in self.replicas:
+                if handle.health == "healthy":
+                    if not handle.alive():
+                        self._mark_lost(handle, "tunnel_dead",
+                                        "worker process exited")
+                        continue
+                    try:
+                        with self._cond:
+                            self._wire_ids += 1
+                            wire_id = self._wire_ids
+                        handle.send(
+                            wire_id, {"id": wire_id, "op": "stats"},
+                            (wire_id, None),
+                        )
+                    except Exception as exc:  # noqa: BLE001
+                        _, kind = classify_retryable(exc)
+                        self._mark_lost(handle, kind or "tunnel_dead",
+                                        f"stats poll failed: {exc}")
+                elif handle.health == "unhealthy" and handle.alive():
+                    # The process survived a transport blip: one reconnect
+                    # attempt per poll tick brings it back into rotation.
+                    try:
+                        handle.connect(timeout_s=0.5)
+                    except Exception:
+                        if not handle.alive():
+                            self._record_transition(
+                                handle, "dead", "tunnel_dead",
+                                "worker process exited during reconnect",
+                            )
+                    else:
+                        self._record_transition(
+                            handle, "healthy", "recovered", "reconnected"
+                        )
+                elif handle.health == "unhealthy" and not handle.alive():
+                    self._record_transition(
+                        handle, "dead", "tunnel_dead",
+                        "worker process exited",
+                    )
+            time.sleep(self.poll_interval_s)
+
+    # ------------------------------------------------------------ readouts
+
+    def _reply_settled(self, ok: bool) -> None:
+        """Per-reply bookkeeping (called from each handle's reader
+        thread); feeds the settle rate behind ``retry_after_ms``."""
+        with self._stats_lock:
+            self._stats["completed" if ok else "failed"] += 1
+
+    def stats(self) -> Dict[str, Any]:
+        """JSON-able snapshot for the manifest's ``serving.router``
+        section: per-replica dispatch counts, health transitions,
+        requeues, and the admission counters."""
+        now = time.monotonic()
+        settled = 0
+        with self._stats_lock:
+            out: Dict[str, Any] = dict(self._stats)
+            transitions = list(self._transitions)
+            settled = out["completed"] + out["failed"]
+        elapsed = max(now - self._started_mono, 1e-6)
+        self._settle_rate = settled / elapsed
+        out.update(
+            replica_count=len(self.replicas),
+            healthy_count=sum(
+                1 for h in self.replicas if h.health == "healthy"
+            ),
+            max_queue=self.max_queue,
+            health_transitions=transitions,
+            replicas={h.name: h.snapshot() for h in self.replicas},
+        )
+        return out
+
+
+# ----------------------------------------------------------------- CLI glue
+
+
+def _replica_cmd(
+    socket_path: str,
+    model: str,
+    mock: bool,
+    weight_quant: Optional[str],
+    tp: int,
+    max_batch: Optional[int],
+    max_wait_ms: Optional[float],
+    max_queue: Optional[int],
+    slots: Optional[int],
+    prefill_chunk: Optional[int],
+    max_new_tokens: int,
+    page_size: Optional[int],
+    kv_pages: Optional[int],
+    warmup: bool,
+) -> List[str]:
+    cmd = [
+        sys.executable, "-m", "music_analyst_tpu", "serve",
+        "--socket", socket_path, "--quiet", "--no-telemetry",
+        "--model", model, "--max-new-tokens", str(int(max_new_tokens)),
+    ]
+    if mock:
+        cmd.append("--mock")
+    if weight_quant:
+        cmd += ["--weight-quant", weight_quant]
+    if tp > 1:
+        cmd += ["--tp", str(int(tp))]
+    for flag, value in (
+        ("--max-batch", max_batch),
+        ("--max-wait-ms", max_wait_ms),
+        ("--max-queue", max_queue),
+        ("--slots", slots),
+        ("--prefill-chunk", prefill_chunk),
+        ("--page-size", page_size),
+        ("--kv-pages", kv_pages),
+    ):
+        if value is not None:
+            cmd += [flag, str(value)]
+    if not warmup:
+        cmd.append("--no-warmup")
+    return cmd
+
+
+def spawn_replicas(
+    n: int,
+    base_dir: str,
+    *,
+    model: str = "mock",
+    mock: bool = False,
+    weight_quant: Optional[str] = None,
+    tp: int = 1,
+    max_batch: Optional[int] = None,
+    max_wait_ms: Optional[float] = None,
+    max_queue: Optional[int] = None,
+    slots: Optional[int] = None,
+    prefill_chunk: Optional[int] = None,
+    max_new_tokens: int = 16,
+    page_size: Optional[int] = None,
+    kv_pages: Optional[int] = None,
+    warmup: bool = True,
+    connect: bool = True,
+) -> List[ReplicaHandle]:
+    """Start ``n`` worker server processes and (optionally) connect.
+
+    Workers inherit the parent environment (so ``MUSICAAL_*`` and the
+    CPU-emulation ``XLA_FLAGS`` flow through) and run with telemetry off
+    — fleet-level stats live in the router's manifest section.
+    """
+    handles: List[ReplicaHandle] = []
+    try:
+        for i in range(n):
+            socket_path = os.path.join(base_dir, f"replica-{i}.sock")
+            cmd = _replica_cmd(
+                socket_path, model, mock, weight_quant, tp, max_batch,
+                max_wait_ms, max_queue, slots, prefill_chunk,
+                max_new_tokens, page_size, kv_pages, warmup,
+            )
+            proc = subprocess.Popen(
+                cmd,
+                stdin=subprocess.DEVNULL,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+                start_new_session=True,
+            )
+            handles.append(
+                ReplicaHandle(f"replica-{i}", socket_path, proc=proc)
+            )
+        if connect:
+            for handle in handles:
+                handle.connect()
+    except Exception:
+        for handle in handles:
+            handle.terminate(grace_s=2.0)
+        raise
+    return handles
+
+
+def run_router(
+    model: str = "mock",
+    mock: bool = False,
+    weight_quant: Optional[str] = None,
+    stdio: bool = False,
+    socket_path: Optional[str] = None,
+    replicas: Optional[int] = None,
+    tp: Optional[int] = None,
+    max_batch: Optional[int] = None,
+    max_wait_ms: Optional[float] = None,
+    max_queue: Optional[int] = None,
+    warmup: bool = True,
+    quiet: bool = False,
+    slots: Optional[int] = None,
+    prefill_chunk: Optional[int] = None,
+    max_new_tokens: int = 16,
+    page_size: Optional[int] = None,
+    kv_pages: Optional[int] = None,
+) -> int:
+    """``serve --replicas N`` (N > 1): spawn the fleet, route until
+    drained.  The front end is a stock ``SentimentServer`` with the
+    router in the batcher seat, so the wire protocol, reply ordering,
+    and graceful-drain semantics are identical to a single server."""
+    import signal
+    import tempfile
+
+    from music_analyst_tpu.serving.server import SentimentServer
+
+    tel = get_telemetry()
+    n = resolve_replicas(replicas)
+    tp_width = resolve_tp(tp)
+    with tel.run_scope("serve", None):
+        with tempfile.TemporaryDirectory(prefix="musicaal-fleet-") as base:
+            handles = spawn_replicas(
+                n, base, model=model, mock=mock, weight_quant=weight_quant,
+                tp=tp_width, max_batch=max_batch, max_wait_ms=max_wait_ms,
+                max_queue=max_queue, slots=slots,
+                prefill_chunk=prefill_chunk,
+                max_new_tokens=max_new_tokens, page_size=page_size,
+                kv_pages=kv_pages, warmup=warmup,
+            )
+            router = ReplicaRouter(handles, max_queue=max_queue).start()
+            server = SentimentServer(
+                router, mode="stdio" if stdio else "unix",
+                decode=_RouterDecode(router), router=router,
+            )
+            tel.annotate(
+                serve_mode=server.mode, router_replicas=n, router_tp=tp_width,
+            )
+            if not quiet:
+                print(
+                    f"serve: routing over {n} replica(s) (tp={tp_width})",
+                    file=sys.stderr,
+                )
+
+            previous: Dict[int, Any] = {}
+
+            def _on_signal(signum, frame) -> None:
+                try:
+                    name = signal.Signals(signum).name
+                except ValueError:  # pragma: no cover
+                    name = str(signum)
+                server.request_drain(f"signal:{name}")
+
+            for signum in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    previous[signum] = signal.signal(signum, _on_signal)
+                except (ValueError, OSError):  # non-main thread (tests)
+                    pass
+            try:
+                if stdio:
+                    server.handle_stream(sys.stdin, sys.stdout,
+                                         drain_on_eof=True)
+                else:
+                    if not socket_path:
+                        raise ValueError(
+                            "serve: --socket PATH (or --stdio) is required"
+                        )
+                    server.serve_unix(socket_path)
+            finally:
+                server._drain_batcher()
+                for signum, prev in previous.items():
+                    try:
+                        signal.signal(signum, prev)
+                    except (ValueError, OSError):
+                        pass
+                stats = router.stats()
+                tel.gauge("router.requests_total", stats["admitted"])
+                tel.gauge("router.requeued_total", stats["requeued"])
+                if not quiet:
+                    print(
+                        f"serve: router drained "
+                        f"({server.drain_reason or 'eof'}): "
+                        f"{stats['dispatched']} dispatched, "
+                        f"{stats['requeued']} requeued, "
+                        f"{len(stats['health_transitions'])} health "
+                        f"transition(s)",
+                        file=sys.stderr,
+                    )
+    return 0

@@ -49,6 +49,7 @@ from music_analyst_tpu.serving.batcher import (
     resolve_max_batch,
     resolve_max_queue,
     resolve_max_wait_ms,
+    resolve_tp,
 )
 from music_analyst_tpu.serving.residency import ModelResidency
 from music_analyst_tpu.telemetry import get_telemetry
@@ -116,6 +117,7 @@ class SentimentServer:
         residency: Optional[ModelResidency] = None,
         mode: str = "stdio",
         decode=None,
+        router=None,
     ) -> None:
         self.batcher = batcher
         self.residency = residency
@@ -123,6 +125,11 @@ class SentimentServer:
         # when the backend has no slot runtime (e.g. --mock) — generate
         # requests then settle as bad_request instead of crashing.
         self.decode = decode
+        # Scale-out mode (serving/router.py): the ReplicaRouter sitting in
+        # the batcher seat, kept separately so stats_snapshot can surface
+        # the fleet view (per-replica dispatch counts, health transitions)
+        # as the manifest's ``serving.router`` section.
+        self.router = router
         self.mode = mode
         self.drain_event = threading.Event()
         self.drain_reason: Optional[str] = None
@@ -360,10 +367,31 @@ class SentimentServer:
             out["decode"] = self.decode.stats()
         if self.residency is not None:
             out["residency"] = self.residency.snapshot()
+        if self.router is not None:
+            out["router"] = self.router.stats()
         return out
 
 
 # ----------------------------------------------------------------- CLI glue
+
+
+def serve_mesh(tp: Optional[int]):
+    """Mesh for ``--tp N``: a 1-D ``tp`` axis over the first N devices
+    (attention heads + KV head axis shard over it, ``DECODE_KV_RULES``);
+    None for the single-chip layout."""
+    width = resolve_tp(tp)
+    if width <= 1:
+        return None
+    import jax
+
+    from music_analyst_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    devices = jax.devices()
+    if len(devices) < width:
+        raise ValueError(
+            f"--tp {width} needs {width} device(s), have {len(devices)}"
+        )
+    return build_mesh(MeshSpec((("tp", width),)), devices=devices[:width])
 
 
 def run_server(
@@ -383,6 +411,7 @@ def run_server(
     max_new_tokens: int = 16,
     page_size: Optional[int] = None,
     kv_pages: Optional[int] = None,
+    tp: Optional[int] = None,
 ) -> int:
     """The ``serve`` subcommand: load, warm, then serve until drained.
 
@@ -394,7 +423,7 @@ def run_server(
     with tel.run_scope("serve", None):
         residency = ModelResidency(
             model=model, mock=mock, weight_quant=weight_quant,
-            backend=backend,
+            backend=backend, mesh=serve_mesh(tp),
         )
         clf = residency.acquire()
         if warmup:
@@ -453,6 +482,7 @@ def run_server(
             max_wait_ms=batcher.max_wait_ms,
             max_queue=batcher.max_queue,
             decode_slots=(decode.plan.n_slots if decode is not None else 0),
+            serve_tp=resolve_tp(tp),
         )
 
         # Graceful SIGTERM/SIGINT: drain instead of dying.  The flight

@@ -21,7 +21,7 @@ def test_every_advertised_module_registers(monkeypatch):
     for expected in (
         "roofline", "flash_sweep", "generation", "coldstart", "ingest",
         "scaling", "joint", "llama_zeroshot", "sentiment_int8", "bucketing",
-        "overlap", "streaming", "serving",
+        "overlap", "streaming", "serving", "router",
     ):
         assert expected in names
 
@@ -43,10 +43,11 @@ def test_suite_runs_smoke(name, monkeypatch):
     json.dumps(table)  # must be a valid JSON document
 
 
-@pytest.mark.parametrize("name", ["coldstart", "scaling"])
+@pytest.mark.parametrize("name", ["coldstart", "scaling", "router"])
 def test_subprocess_suite_runs_smoke(name, monkeypatch):
-    """The two suites that spawn fresh Python processes (cold-start cost,
-    device-count sweep) — slower, so split out for visibility."""
+    """The suites that spawn fresh Python processes (cold-start cost,
+    device-count sweep, replica fleet) — slower, so split out for
+    visibility."""
     monkeypatch.setenv("MUSICAAL_BENCH_SMOKE", "1")
     import benchmarks
 
@@ -56,5 +57,8 @@ def test_subprocess_suite_runs_smoke(name, monkeypatch):
     json.dumps(table)
     if name == "coldstart":
         assert table["warm_process_seconds"] > 0
+    elif name == "router":
+        assert table["failover_drill"]["zero_loss"] is True
+        assert all(r["balanced"] for r in table["rows"])
     else:
         assert len(table["runs"]) >= 1

@@ -1,0 +1,312 @@
+"""Replica router: JSQ dispatch, health-aware failover, zero loss.
+
+The scale-out serving contracts (ISSUE 12):
+
+* every admitted request settles — answered by a replica (possibly after
+  a requeue when its first replica died) or failed with a structured
+  error; nothing is silently dropped;
+* killing one of N replicas under load loses zero admitted requests and
+  records the health transition for the manifest;
+* outputs are byte-identical whether one replica or N serve the fleet
+  (dispatch placement may never change an answer);
+* the ``router.dispatch`` fault site is absorbed by the shared retry
+  policy, and ``router_stall`` is a classified taxonomy kind.
+
+The fleet spawns real worker processes (``python -m music_analyst_tpu
+serve --socket … --mock``), so these tests cover the wire protocol and
+process lifecycle end-to-end, not just the dispatch data structures.
+"""
+
+import io
+import json
+import os
+import signal
+import time
+
+import pytest
+
+from music_analyst_tpu.serving.batcher import resolve_replicas, resolve_tp
+from music_analyst_tpu.serving.router import (
+    ReplicaHandle,
+    ReplicaRouter,
+    _RouterDecode,
+    router_stats,
+    spawn_replicas,
+)
+
+
+def test_resolve_replicas_and_tp(monkeypatch):
+    assert resolve_replicas(None) == 1
+    assert resolve_replicas(3) == 3
+    monkeypatch.setenv("MUSICAAL_SERVE_REPLICAS", "4")
+    assert resolve_replicas(None) == 4
+    monkeypatch.setenv("MUSICAAL_SERVE_REPLICAS", "junk")
+    assert resolve_replicas(None) == 1  # malformed env falls back
+    with pytest.raises(ValueError):
+        resolve_replicas("junk")  # explicit value is a usage error
+    with pytest.raises(ValueError):
+        resolve_replicas(0)
+
+    assert resolve_tp(None) == 1
+    assert resolve_tp(2) == 2
+    monkeypatch.setenv("MUSICAAL_SERVE_TP", "2")
+    assert resolve_tp(None) == 2
+    monkeypatch.setenv("MUSICAAL_SERVE_TP", "-3")
+    assert resolve_tp(None) == 1
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    """Two mock worker processes behind one router (shared across the
+    read-only tests; the kill test spawns its own victims)."""
+    base = tmp_path_factory.mktemp("fleet")
+    handles = spawn_replicas(
+        2, str(base), model="mock", mock=True, warmup=False
+    )
+    router = ReplicaRouter(handles, poll_interval_s=0.1).start()
+    yield router, handles
+    router.drain()
+
+
+def _settle(reqs, timeout=30.0):
+    for req in reqs:
+        assert req.wait(timeout), f"request {req.id} never settled"
+    return [req.response for req in reqs]
+
+
+TEXTS = [
+    "I love the sunshine and happy days",
+    "tears and sorrow in the lonely night",
+    "",
+    "la la la the radio plays",
+    "broken hearts mend slowly",
+    "dancing together in the summer rain",
+    "cry me a river",
+    "golden mornings forever",
+]
+
+
+def test_dispatch_balance_and_zero_loss(fleet):
+    router, handles = fleet
+    reqs = [
+        router.submit(i, "sentiment", TEXTS[i % len(TEXTS)])
+        for i in range(16)
+    ]
+    responses = _settle(reqs)
+    assert all(r.get("ok") for r in responses), responses
+    stats = router.stats()
+    per_replica = {
+        name: snap["dispatched"] for name, snap in stats["replicas"].items()
+    }
+    # JSQ must use both replicas at offered load >> fleet width.
+    assert all(n > 0 for n in per_replica.values()), per_replica
+    assert stats["admitted"] >= 16
+    assert router_stats()["replica_count"] == 2
+
+
+def test_cross_replica_determinism(fleet):
+    """The fleet's answers are identical to the in-process backend's —
+    dispatch placement (1 replica or N, whichever replica answers) may
+    never change a label."""
+    from music_analyst_tpu.engines.sentiment import get_backend
+
+    router, _ = fleet
+    expected = get_backend("mock", mock=True).classify_batch(TEXTS)
+    reqs = [
+        router.submit(f"det-{i}", "sentiment", text)
+        for i, text in enumerate(TEXTS)
+    ]
+    responses = _settle(reqs)
+    assert [r["label"] for r in responses] == expected
+    # And again, to cross replicas regardless of which took round one.
+    reqs = [
+        router.submit(f"det2-{i}", "sentiment", text)
+        for i, text in enumerate(TEXTS)
+    ]
+    assert [r["label"] for r in _settle(reqs)] == expected
+
+
+def test_wordcount_op_routes_and_matches_contract(fleet):
+    router, _ = fleet
+    req = router.submit("wc", "wordcount", "hello hello world")
+    (resp,) = _settle([req])
+    assert resp["ok"] and resp["counts"] == {"hello": 2, "world": 1}
+
+
+def test_bad_op_fails_at_the_router_edge(fleet):
+    router, _ = fleet
+    req = router.submit("bad", "no-such-op", "text")
+    assert req.done  # settled synchronously, never dispatched
+    assert req.response["error"]["kind"] == "bad_request"
+
+
+def test_injected_dispatch_fault_absorbed_in_place(fleet):
+    """``router.dispatch:error@1`` trips once and the shared RetryPolicy
+    absorbs it against the same replica — no health transition."""
+    from music_analyst_tpu.resilience import (
+        configure_faults,
+        fault_stats,
+    )
+
+    router, _ = fleet
+    before = len(router.stats()["health_transitions"])
+    configure_faults("router.dispatch:error@1")
+    try:
+        reqs = [
+            router.submit(f"fault-{i}", "sentiment", "happy text")
+            for i in range(4)
+        ]
+        responses = _settle(reqs)
+        trips = fault_stats()["router.dispatch"]["trips"]
+    finally:
+        configure_faults(None)
+    assert all(r.get("ok") for r in responses), responses
+    assert trips == 1
+    assert len(router.stats()["health_transitions"]) == before
+
+
+def test_kill_replica_under_load_loses_nothing(tmp_path):
+    """SIGKILL one of two replicas with requests in flight: the victims'
+    pending requests requeue to the survivor, every admitted request is
+    answered, and the manifest-visible health transition is recorded."""
+    handles = spawn_replicas(
+        2, str(tmp_path), model="mock", mock=True, warmup=False
+    )
+    router = ReplicaRouter(handles, poll_interval_s=0.05).start()
+    try:
+        first = [
+            router.submit(i, "sentiment", TEXTS[i % len(TEXTS)])
+            for i in range(4)
+        ]
+        os.kill(handles[0].proc.pid, signal.SIGKILL)
+        second = [
+            router.submit(100 + i, "sentiment", TEXTS[i % len(TEXTS)])
+            for i in range(8)
+        ]
+        responses = _settle(first + second, timeout=60.0)
+        assert all(r is not None for r in responses)
+        assert all(r.get("ok") for r in responses), responses
+        stats = router.stats()
+        transitions = stats["health_transitions"]
+        assert transitions, "replica death must record a transition"
+        assert transitions[0]["replica"] == "replica-0"
+        assert transitions[0]["to"] in ("unhealthy", "dead")
+        assert transitions[0]["kind"] == "tunnel_dead"
+        # The poll thread eventually notices the corpse is gone for good.
+        deadline = time.monotonic() + 5.0
+        while (handles[0].health != "dead"
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert handles[0].health == "dead"
+        assert handles[1].health == "healthy"
+    finally:
+        router.drain()
+
+
+def test_all_replicas_dead_fails_structurally(tmp_path):
+    """No healthy replica → admitted requests fail with ``replica_lost``
+    (classified router_stall), not a hang or a drop."""
+    handle = ReplicaHandle("replica-0", str(tmp_path / "never.sock"))
+    handle.health = "dead"
+    router = ReplicaRouter([handle], max_queue=4).start()
+    try:
+        req = router.submit("r1", "sentiment", "text")
+        assert req.wait(10.0)
+        assert req.response["error"]["kind"] == "replica_lost"
+    finally:
+        router.drain()
+
+
+def test_queue_full_shed_carries_retry_after(tmp_path):
+    handle = ReplicaHandle("replica-0", str(tmp_path / "never.sock"))
+    router = ReplicaRouter([handle], max_queue=1)  # dispatch NOT started
+    router.submit("q1", "sentiment", "fills the queue")
+    shed = router.submit("q2", "sentiment", "bounced")
+    assert shed.done
+    error = shed.response["error"]
+    assert error["kind"] == "queue_full"
+    assert error["retry_after_ms"] >= 1.0
+    assert router.stats()["shed"] == 1
+    assert router.stats()["retry_after_ms_last"] == error["retry_after_ms"]
+
+
+def test_router_stall_taxonomy_and_classification():
+    from music_analyst_tpu.observability.report import classify_error
+    from music_analyst_tpu.observability.watchdog import TAXONOMY
+    from music_analyst_tpu.resilience.faults import SITES
+
+    assert TAXONOMY["router"] == "router_stall"
+    assert "router.dispatch" in SITES
+    assert classify_error("replica lost (tunnel_dead)") == "router_stall"
+    assert classify_error("router.dispatch gave up") == "router_stall"
+
+
+def test_server_fronts_router_with_manifest_section(fleet):
+    """A stock SentimentServer with the router in the batcher seat:
+    in-order NDJSON replies, and stats_snapshot carries the fleet view
+    (the manifest's ``serving.router`` section)."""
+    from music_analyst_tpu.serving.server import SentimentServer
+
+    router, _ = fleet
+    server = SentimentServer(
+        router, mode="stdio", decode=_RouterDecode(router), router=router
+    )
+    lines = "\n".join([
+        json.dumps({"id": "a", "op": "sentiment", "text": TEXTS[0]}),
+        json.dumps({"id": "b", "op": "wordcount", "text": "la la la"}),
+        json.dumps({"id": "c", "op": "ping"}),
+    ]) + "\n"
+    out = io.StringIO()
+    written = server.handle_stream(io.StringIO(lines), out)
+    assert written == 3
+    replies = [json.loads(l) for l in out.getvalue().splitlines()]
+    assert [r["id"] for r in replies] == ["a", "b", "c"]
+    assert all(r["ok"] for r in replies)
+    snapshot = server.stats_snapshot()
+    assert snapshot["router"]["replica_count"] == 2
+    assert "replica-0" in snapshot["router"]["replicas"]
+    assert snapshot["router"]["dispatched"] >= 2
+
+
+def test_report_aggregates_router_fleet(tmp_path):
+    """telemetry-report surfaces per-replica dispatch counts and health
+    transitions from the manifest's serving.router section."""
+    from music_analyst_tpu.observability.report import (
+        build_report,
+        render_report,
+    )
+
+    manifest = {
+        "run": "serve", "ok": True, "wall_seconds": 1.0,
+        "serving": {
+            "router": {
+                "replica_count": 2, "healthy_count": 1,
+                "dispatched": 10, "requeued": 3, "shed": 0,
+                "health_transitions": [
+                    {"replica": "replica-0", "from": "healthy",
+                     "to": "dead", "kind": "tunnel_dead",
+                     "reason": "worker process exited", "t_s": 0.5},
+                ],
+                "replicas": {
+                    "replica-0": {"dispatched": 4, "requeues": 3,
+                                  "health": "dead"},
+                    "replica-1": {"dispatched": 6, "requeues": 0,
+                                  "health": "healthy"},
+                },
+            },
+        },
+    }
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    (run_dir / "run_manifest.json").write_text(json.dumps(manifest))
+    from music_analyst_tpu.observability.report import load_run
+
+    record = load_run(str(run_dir))
+    report = build_report([record])
+    (entry,) = report["router_fleet"]
+    assert entry["replica_count"] == 2
+    assert entry["health_transitions"] == 1
+    assert entry["replicas"]["replica-1"]["dispatched"] == 6
+    text = "\n".join(render_report(report))
+    assert "router fleet" in text
+    assert "replica-0: 4 / 3 / dead" in text

@@ -173,6 +173,61 @@ def test_padding_is_pow2_buckets():
         b.drain()
 
 
+def test_in_batch_dedup_folds_identical_texts():
+    """Identical texts in one flush occupy ONE device row; every
+    requester still gets its own (identical) reply."""
+    sizes = []
+    b = DynamicBatcher(_echo_ops(sizes), max_batch=6,
+                       max_wait_ms=10_000.0, max_queue=16).start()
+    try:
+        texts = ["same song"] * 4 + ["other", "third"]
+        reqs = [b.submit(i, "echo", t) for i, t in enumerate(texts)]
+        for r in reqs:
+            assert r.wait(5.0)
+        # All six answered, each with its own text, despite 3 rows folded.
+        assert [r.response["text"] for r in reqs] == texts
+        assert sizes == [3]  # the device saw only the unique rows
+        stats = b.stats()
+        assert stats["completed"] == 6
+        assert stats["rows"] == 3
+        assert stats["dedup_folded"] == 3
+        assert stats["dedup_factor"] == 2.0  # (3 + 3) / 3
+    finally:
+        b.drain()
+
+
+def test_queue_full_shed_carries_retry_after_hint():
+    """A shed reply tells the client when to come back: the hint is the
+    queue-drain estimate, floored at one flush deadline and capped."""
+    from music_analyst_tpu.serving.batcher import _RETRY_AFTER_CAP_MS
+
+    b = DynamicBatcher(_echo_ops(delay_s=0.05), max_batch=2,
+                       max_wait_ms=5.0, max_queue=2).start()
+    try:
+        reqs = [b.submit(i, "echo", f"t{i}") for i in range(12)]
+        for r in reqs:
+            assert r.wait(10.0)
+        shed = [r.response for r in reqs if not r.response["ok"]]
+        assert shed
+        for resp in shed:
+            hint = resp["error"]["retry_after_ms"]
+            assert 5.0 <= hint <= _RETRY_AFTER_CAP_MS
+        assert b.stats()["retry_after_ms_last"] == \
+            shed[-1]["error"]["retry_after_ms"]
+    finally:
+        b.drain()
+
+
+def test_retry_after_estimate_floors_and_rates():
+    b = DynamicBatcher(_echo_ops(), max_batch=4, max_wait_ms=10.0,
+                       max_queue=64)
+    # No flush yet: falls back to queued-batches × deadline, floored.
+    assert b.retry_after_ms(depth=0) == 10.0
+    assert b.retry_after_ms(depth=8) == 20.0  # 2 full batches × 10 ms
+    b._flush_rate = 100.0  # rows/s observed
+    assert b.retry_after_ms(depth=4) == 40.0  # 4 rows / 100 per s
+
+
 # ---------------------------------------------------------------- residency
 
 

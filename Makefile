@@ -221,6 +221,41 @@ print('smoke ok:', payload['metric'], payload['value'])"
 	grep -q '"retry.ingest.read"' "$$chaostmp/faulted/run_manifest.json" || \
 		{ echo "injected run manifest lacks the retry counter"; exit 1; }; \
 	echo "chaos injected-fault self-check ok"
+	# overload self-check: burst one stdio stream past a 1 req/s bulk
+	# tenant budget while a single high-priority gold request rides along
+	# — gold must be answered ok inside its (generous) TTFT SLO, every
+	# bulk shed must be structured (queue_full/slo_unattainable with a
+	# numeric retry_after_ms), and the stats op's slo section must show
+	# the sheds charged to the bulk tenant only (per-tenant isolation).
+	overtmp=$$(mktemp -d) && trap 'rm -rf "$$overtmp"' EXIT && \
+	{ for i in 0 1 2 3 4 5 6 7 8 9; do \
+		printf '{"id":"b%s","op":"sentiment","text":"bulk row %s","tenant":"bulk","priority":1}\n' "$$i" "$$i"; \
+	done; \
+	printf '%s\n' \
+		'{"id":"gold","op":"sentiment","text":"I love this happy day","tenant":"gold","priority":5}' \
+		'{"id":"end","op":"stats"}'; } | \
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+		$(PY) -m music_analyst_tpu serve --stdio --mock --quiet \
+		--max-batch 4 --max-wait-ms 2 --max-queue 8 \
+		--tenant-budget 1 --ttft-slo-ms 5000 \
+		> "$$overtmp/replies.ndjson" || { echo "overload serve run failed"; exit 1; }; \
+	$(PY) -c "import json,sys; \
+	lines=[json.loads(l) for l in open(sys.argv[1]) if l.strip()]; \
+	assert len(lines)==12, f'expected 12 replies, got {len(lines)}'; \
+	by_id={r['id']: r for r in lines}; \
+	assert by_id['gold']['ok'], by_id['gold']; \
+	sheds=[r for r in lines if not r.get('ok') and r['id']!='end']; \
+	assert sheds, 'burst past the tenant budget shed nothing'; \
+	assert all(r['error']['kind'] in ('queue_full','slo_unattainable') \
+	           and r['error'].get('retry_after_ms', 0) >= 1.0 \
+	           for r in sheds), sheds; \
+	slo=by_id['end']['stats']['slo']; \
+	assert slo['tenants']['bulk']['shed'] >= 1, slo; \
+	assert slo['tenants']['gold']['shed'] == 0, slo; \
+	print('overload self-check ok:', by_id['gold']['label'], 'gold,', \
+	      len(sheds), 'structured shed(s)')" \
+		"$$overtmp/replies.ndjson" || \
+		{ echo "overload self-check failed"; exit 1; }
 
 test:
 	$(PY) -m pytest tests/ -q

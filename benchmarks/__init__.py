@@ -40,6 +40,7 @@ _SUITE_MODULES = (
     "benchmarks.continuous",
     "benchmarks.router",
     "benchmarks.chaos",
+    "benchmarks.slo",
 )
 
 

@@ -24,7 +24,7 @@ mkdir -p "$out_dir"
 # capture is the 8-virtual-device CPU-mesh sweep, and on the one-chip
 # environment a re-run would record a trivial np=1 sweep over it.  Pass
 # it explicitly from a multi-device host to refresh.
-suites=${*:-"roofline ingest flash_sweep generation coldstart joint llama_zeroshot sentiment_int8 bucketing streaming wq_store serving continuous router chaos"}
+suites=${*:-"roofline ingest flash_sweep generation coldstart joint llama_zeroshot sentiment_int8 bucketing streaming wq_store serving continuous router chaos slo"}
 
 # Freshness window for the resume check (seconds).
 fresh_s=${MUSICAAL_CAPTURE_FRESH_S:-86400}

@@ -291,6 +291,74 @@ def _prefix_lookup_scenario(n_requests: int) -> dict:
     }
 
 
+def _preempt_scenario() -> dict:
+    """Injected ``scheduler.preempt`` fault: the steal is abandoned
+    BEFORE any slot mutation, so the run degrades to "no preemption this
+    tick" — the victim keeps its slot, every request still answers, and
+    the bytes match a clean staged-preemption run.  Never a half-zeroed
+    slot."""
+    from music_analyst_tpu.models.llama import (
+        LlamaConfig,
+        LlamaZeroShotClassifier,
+    )
+    from music_analyst_tpu.resilience import configure_faults, fault_stats
+    from music_analyst_tpu.serving.decode_loop import ContinuousScheduler
+
+    clf = LlamaZeroShotClassifier(
+        config=LlamaConfig.tiny(), max_prompt_len=64
+    )
+    sched = ContinuousScheduler(
+        clf, n_slots=1, prefill_chunk=16, prompt_region=64,
+        max_new_tokens=8, max_queue=8, page_size=8, kv_pages=32,
+        ttft_slo_ms=1.0,  # arm preemption; deadlines below stay generous
+    )
+    sched.warmup()
+
+    def _staged(tag: str) -> dict:
+        low = sched.submit(f"low-{tag}", "slow chaos ballad",
+                           max_new_tokens=8, priority=1,
+                           deadline_ms=60_000.0)
+        for _ in range(32):
+            sched._tick()
+            slot = sched._slots[0]
+            if slot is not None and slot.active and slot.steps > 0:
+                break
+        high = sched.submit(f"high-{tag}", "gold chaos chorus",
+                            max_new_tokens=8, priority=5,
+                            deadline_ms=60_000.0)
+        sched.run_until_idle()
+        out = {}
+        for req in (low, high):
+            resp = req.response or {}
+            if not resp.get("ok"):
+                raise RuntimeError(f"{req.id} failed: {resp.get('error')}")
+            out[str(req.id).split("-")[0]] = resp["text"]
+        return out
+
+    start = time.perf_counter()
+    clean = _staged("clean")
+    preempts_clean = sched.stats()["preemptions"]
+    configure_faults("scheduler.preempt:error@1+")
+    try:
+        faulted = _staged("faulted")
+        trips = fault_stats()["scheduler.preempt"]["trips"]
+    finally:
+        configure_faults(None)
+    elapsed = time.perf_counter() - start
+    stats = sched.stats()
+    return {
+        "scenario": "scheduler_preempt_fault",
+        "spec": "scheduler.preempt:error@1+",
+        "preemptions_clean": preempts_clean,
+        "preemptions_faulted": stats["preemptions"] - preempts_clean,
+        "preempt_faults": stats["preempt_faults"],
+        "trips": trips,
+        "bytes_identical": faulted == clean,
+        "all_answered": True,  # _staged raises otherwise
+        "wall_s": round(elapsed, 4),
+    }
+
+
 @suite("chaos")
 def run() -> dict:
     from music_analyst_tpu.resilience import (
@@ -389,6 +457,15 @@ def run() -> dict:
             file=sys.stderr,
         )
 
+        preempt = _preempt_scenario()
+        print(
+            f"[chaos] preempt_fault: identical="
+            f"{preempt['bytes_identical']} "
+            f"faults={preempt['preempt_faults']} "
+            f"wall={preempt['wall_s']:.3f}s",
+            file=sys.stderr,
+        )
+
     reset_retry_stats()
     return {
         "suite": "chaos",
@@ -402,13 +479,16 @@ def run() -> dict:
         "decode": decode,
         "router": router,
         "prefix_lookup": prefix,
+        "preempt_fault": preempt,
         "all_identical": all(
             s["bytes_identical"] for s in scenarios
-        ) and prefix["bytes_identical"],
+        ) and prefix["bytes_identical"] and preempt["bytes_identical"],
         "all_recovered": all(
             s["trips"] > 0
             and (s["degraded"] if s["expect_degraded"] else True)
             for s in scenarios
         ) and serving["all_answered"] and decode["all_answered"]
-        and router["all_answered"] and prefix["all_fell_back"],
+        and router["all_answered"] and prefix["all_fell_back"]
+        and preempt["preempt_faults"] > 0
+        and preempt["preemptions_faulted"] == 0,
     }

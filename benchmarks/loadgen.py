@@ -1,0 +1,270 @@
+"""Closed-loop, trace-driven load generator for the serving stack.
+
+Overload behavior is only credible when the *offered* load is
+reproducible: a seeded arrival trace (timestamps + per-request identity)
+is generated up front, then replayed against a live target by sleeping to
+each timestamp.  The same seed always produces the same trace, so a
+flash-crowd run that sheds tenant X at t=0.42s sheds the same request on
+every machine — chaos composition (``loadgen.tick`` faults) stays
+deterministic too.
+
+Three trace shapes cover the PERFORMANCE.md overload section:
+
+* :func:`poisson_arrivals` — homogeneous Poisson at a fixed rate (the
+  steady-state sanity trace);
+* :func:`diurnal_arrivals` — inhomogeneous Poisson via thinning against
+  a half-sine intensity ramp (slow overload onset);
+* :func:`flash_crowd_arrivals` — piecewise-constant rate with a burst
+  window at N× the base rate (the SLO-shedding stress trace).
+
+Every builder takes ``classes``: weighted request classes carrying the
+per-tenant identity (``tenant``/``priority``/``deadline_ms``/``op``), so
+one trace can blend a low-priority bulk flood with sparse high-priority
+"gold" traffic — the isolation story in one replay.
+
+:class:`LoadGen` replays a trace and classifies every settled request:
+``ok``, shed (``queue_full``/``slo_unattainable`` — both must carry
+``retry_after_ms``), failed (anything else), or — the contract breach —
+silently dropped (never settled).  Latency percentiles come out keyed by
+``tenant/priority`` so a starving tenant is visible directly.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import time
+from dataclasses import dataclass, replace
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from music_analyst_tpu.resilience.faults import InjectedFault, fault_point
+
+_SHED_KINDS = ("queue_full", "slo_unattainable")
+
+_DEFAULT_TEXTS = (
+    "sunshine on the golden river",
+    "tears fall in the lonely night",
+    "dancing under silver skies",
+    "broken hearts mend slowly now",
+    "the radio plays our song again",
+)
+
+
+@dataclass(frozen=True)
+class Arrival:
+    """One trace event: when it arrives and what it asks for."""
+
+    t_s: float
+    op: str = "sentiment"
+    text: str = _DEFAULT_TEXTS[0]
+    tenant: str = "default"
+    priority: int = 1
+    deadline_ms: Optional[float] = None
+    max_new_tokens: Optional[int] = None
+
+
+# A request class: optional "weight" (default 1.0) plus Arrival field
+# overrides ("op", "tenant", "priority", "deadline_ms", "max_new_tokens").
+RequestClass = Dict[str, Any]
+
+
+def _pick_class(rng: random.Random,
+                classes: Sequence[RequestClass]) -> RequestClass:
+    total = sum(float(c.get("weight", 1.0)) for c in classes)
+    r = rng.random() * total
+    for cls in classes:
+        r -= float(cls.get("weight", 1.0))
+        if r <= 0.0:
+            return cls
+    return classes[-1]
+
+
+def _materialize(t_s: float, rng: random.Random,
+                 classes: Optional[Sequence[RequestClass]]) -> Arrival:
+    base = Arrival(t_s=t_s, text=rng.choice(_DEFAULT_TEXTS))
+    if not classes:
+        return base
+    cls = _pick_class(rng, classes)
+    fields = {k: v for k, v in cls.items() if k != "weight"}
+    return replace(base, **fields)
+
+
+def poisson_arrivals(
+    rate_rps: float,
+    duration_s: float,
+    seed: int = 0,
+    classes: Optional[Sequence[RequestClass]] = None,
+) -> List[Arrival]:
+    """Homogeneous Poisson: exponential gaps at ``rate_rps``."""
+    if rate_rps <= 0.0:
+        return []
+    rng = random.Random(seed)
+    out: List[Arrival] = []
+    t = rng.expovariate(rate_rps)
+    while t < duration_s:
+        out.append(_materialize(t, rng, classes))
+        t += rng.expovariate(rate_rps)
+    return out
+
+
+def diurnal_arrivals(
+    base_rps: float,
+    peak_rps: float,
+    duration_s: float,
+    seed: int = 0,
+    classes: Optional[Sequence[RequestClass]] = None,
+) -> List[Arrival]:
+    """Inhomogeneous Poisson, intensity ramping base→peak→base as a
+    half-sine over the window (thinning against the peak rate)."""
+    peak = max(base_rps, peak_rps)
+    if peak <= 0.0:
+        return []
+    rng = random.Random(seed)
+    out: List[Arrival] = []
+    t = rng.expovariate(peak)
+    while t < duration_s:
+        lam = base_rps + (peak_rps - base_rps) * math.sin(
+            math.pi * t / duration_s
+        )
+        if rng.random() < lam / peak:
+            out.append(_materialize(t, rng, classes))
+        t += rng.expovariate(peak)
+    return out
+
+
+def flash_crowd_arrivals(
+    base_rps: float,
+    burst_rps: float,
+    duration_s: float,
+    burst_start_s: float,
+    burst_len_s: float,
+    seed: int = 0,
+    classes: Optional[Sequence[RequestClass]] = None,
+) -> List[Arrival]:
+    """Piecewise-constant rate: ``base_rps`` everywhere, ``burst_rps``
+    inside the burst window (thinning against the larger rate)."""
+    peak = max(base_rps, burst_rps)
+    if peak <= 0.0:
+        return []
+    rng = random.Random(seed)
+    out: List[Arrival] = []
+    t = rng.expovariate(peak)
+    burst_end = burst_start_s + burst_len_s
+    while t < duration_s:
+        lam = burst_rps if burst_start_s <= t < burst_end else base_rps
+        if rng.random() < lam / peak:
+            out.append(_materialize(t, rng, classes))
+        t += rng.expovariate(peak)
+    return out
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile on an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    rank = max(1, math.ceil(q / 100.0 * len(sorted_vals)))
+    return sorted_vals[rank - 1]
+
+
+class LoadGen:
+    """Replay a trace against one submit function, closed-loop.
+
+    ``submit(rid, arrival)`` must return a settle-able request object
+    (``wait``/``done``/``response``/``t_enqueue``/``t_settle`` — the
+    serving stack's ``ServeRequest``); sheds settle synchronously inside
+    submit, which is exactly what the report wants to see.
+    """
+
+    def __init__(self, submit: Callable[[Any, Arrival], Any],
+                 time_scale: float = 1.0) -> None:
+        self.submit = submit
+        self.time_scale = time_scale
+
+    def replay(self, arrivals: Sequence[Arrival],
+               settle_timeout_s: float = 120.0) -> Dict[str, Any]:
+        events = sorted(arrivals, key=lambda a: a.t_s)
+        t0 = time.monotonic()
+        live: List[Tuple[Arrival, Any]] = []
+        ticks_faulted = 0
+        for i, arrival in enumerate(events):
+            due = t0 + arrival.t_s * self.time_scale
+            delay = due - time.monotonic()
+            if delay > 0.0:
+                time.sleep(delay)
+            try:
+                fault_point("loadgen.tick", index=i, t_s=arrival.t_s,
+                            tenant=arrival.tenant)
+            except InjectedFault:
+                # A faulted tick drops the *offered* request before it
+                # ever reaches the target — the degraded-trace scenario:
+                # the target must stay consistent, nothing half-submitted.
+                ticks_faulted += 1
+                continue
+            live.append((arrival, self.submit(i, arrival)))
+        wall_s = time.monotonic() - t0
+        return self._report(live, len(events), ticks_faulted, wall_s,
+                            settle_timeout_s)
+
+    def _report(self, live: List[Tuple[Arrival, Any]], offered: int,
+                ticks_faulted: int, replay_wall_s: float,
+                settle_timeout_s: float) -> Dict[str, Any]:
+        deadline = time.monotonic() + settle_timeout_s
+        ok = failed = silent = 0
+        sheds: Dict[str, int] = {kind: 0 for kind in _SHED_KINDS}
+        sheds_with_hint = 0
+        latencies: Dict[str, List[float]] = {}
+        by_tenant: Dict[str, Dict[str, int]] = {}
+        for arrival, req in live:
+            tkey = f"{arrival.tenant}/p{arrival.priority}"
+            bucket = by_tenant.setdefault(
+                tkey, {"offered": 0, "ok": 0, "shed": 0, "failed": 0}
+            )
+            bucket["offered"] += 1
+            if not req.wait(max(0.0, deadline - time.monotonic())):
+                silent += 1  # the contract breach: never settled
+                continue
+            resp = req.response or {}
+            if resp.get("ok"):
+                ok += 1
+                bucket["ok"] += 1
+                if req.t_settle is not None:
+                    latencies.setdefault(tkey, []).append(
+                        (req.t_settle - req.t_enqueue) * 1000.0
+                    )
+                continue
+            error = resp.get("error") or {}
+            kind = error.get("kind")
+            if kind in _SHED_KINDS:
+                sheds[kind] += 1
+                bucket["shed"] += 1
+                if isinstance(error.get("retry_after_ms"), (int, float)):
+                    sheds_with_hint += 1
+            else:
+                failed += 1
+                bucket["failed"] += 1
+        latency_ms = {}
+        for tkey, vals in sorted(latencies.items()):
+            vals.sort()
+            latency_ms[tkey] = {
+                "n": len(vals),
+                "p50": round(_percentile(vals, 50.0), 3),
+                "p95": round(_percentile(vals, 95.0), 3),
+                "p99": round(_percentile(vals, 99.0), 3),
+                "max": round(vals[-1], 3),
+            }
+        shed_total = sum(sheds.values())
+        return {
+            "offered": offered,
+            "ticks_faulted": ticks_faulted,
+            "submitted": len(live),
+            "ok": ok,
+            "shed": shed_total,
+            "shed_kinds": sheds,
+            "sheds_with_hint": sheds_with_hint,
+            "sheds_structured": sheds_with_hint == shed_total,
+            "failed": failed,
+            "silent_drops": silent,
+            "replay_wall_s": round(replay_wall_s, 4),
+            "latency_ms": latency_ms,
+            "tenants": by_tenant,
+        }

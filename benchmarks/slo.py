@@ -1,0 +1,285 @@
+"""SLO suite: overload behavior under seeded, trace-driven load.
+
+Backs the "Overload behavior" section in PERFORMANCE.md.  Each scenario
+replays a seeded arrival trace (``benchmarks/loadgen.py``) against a live
+serving target and checks the overload-robustness contracts from the
+SLO tentpole:
+
+* **structured shedding** — every rejection is ``queue_full`` or
+  ``slo_unattainable`` and carries ``retry_after_ms``; nothing is
+  silently dropped;
+* **isolation** — a flash crowd from a bulk tenant cannot push a sparse
+  high-priority "gold" tenant's TTFT p99 past its SLO (priority classes
+  + eviction + token buckets);
+* **preemption correctness** — a preempted-then-resumed decode produces
+  byte-identical output with zero retraces (``compiled_variants`` flat).
+
+The batcher scenarios use a deliberately slow op so "sustainable load"
+is a known constant (max_batch / batch_seconds) and the flash crowd can
+be pinned at ≥4× that — on any machine, since the bottleneck is an
+injected sleep, not CPU speed.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from benchmarks import suite
+from benchmarks._util import device_info, smoke
+from benchmarks.loadgen import (
+    LoadGen,
+    diurnal_arrivals,
+    flash_crowd_arrivals,
+    poisson_arrivals,
+)
+
+# Known-capacity op: one batch costs _BATCH_S regardless of size, so the
+# sustainable rate is exactly max_batch / _BATCH_S requests/second.
+_BATCH_S = 0.02
+_MAX_BATCH = 4
+_CAPACITY_RPS = _MAX_BATCH / _BATCH_S  # 200 req/s
+
+_GOLD_SLO_MS = 500.0
+
+
+def _slow_ops():
+    def classify(texts):
+        time.sleep(_BATCH_S)
+        return [{"label": "Positive"} for _ in texts]
+
+    return {"sentiment": classify}
+
+
+def _batcher(max_queue: int, **slo_kwargs):
+    from music_analyst_tpu.serving.batcher import DynamicBatcher
+
+    return DynamicBatcher(
+        _slow_ops(), max_batch=_MAX_BATCH, max_wait_ms=1.0,
+        max_queue=max_queue, **slo_kwargs,
+    ).start()
+
+
+def _batcher_submit(batcher):
+    def submit(rid, arrival):
+        return batcher.submit(
+            rid, arrival.op, arrival.text, tenant=arrival.tenant,
+            priority=arrival.priority, deadline_ms=arrival.deadline_ms,
+        )
+
+    return submit
+
+
+def _steady_scenario(seed: int) -> dict:
+    """Poisson at half capacity: nothing sheds, everything settles."""
+    duration = 0.6 if smoke() else 3.0
+    trace = poisson_arrivals(_CAPACITY_RPS * 0.5, duration, seed=seed)
+    batcher = _batcher(max_queue=256)
+    try:
+        report = LoadGen(_batcher_submit(batcher)).replay(trace)
+    finally:
+        batcher.drain()
+    report.update(
+        scenario="steady_poisson",
+        offered_rps=round(_CAPACITY_RPS * 0.5, 1),
+        capacity_rps=_CAPACITY_RPS,
+        clean=report["shed"] == 0 and report["failed"] == 0
+        and report["silent_drops"] == 0,
+    )
+    return report
+
+
+def _diurnal_scenario(seed: int) -> dict:
+    """Half-sine ramp peaking at 2× capacity: overload arrives slowly,
+    sheds begin near the peak, and every shed is structured."""
+    duration = 0.8 if smoke() else 4.0
+    trace = diurnal_arrivals(
+        _CAPACITY_RPS * 0.25, _CAPACITY_RPS * 2.0, duration, seed=seed,
+        classes=[{"tenant": "bulk", "deadline_ms": 250.0}],
+    )
+    batcher = _batcher(max_queue=16, ttft_slo_ms=250.0)
+    try:
+        report = LoadGen(_batcher_submit(batcher)).replay(trace)
+    finally:
+        batcher.drain()
+    report.update(
+        scenario="diurnal_ramp",
+        peak_rps=round(_CAPACITY_RPS * 2.0, 1),
+        capacity_rps=_CAPACITY_RPS,
+    )
+    return report
+
+
+def _flash_crowd_scenario(seed: int) -> dict:
+    """The acceptance trace: a bulk tenant bursts to 4× sustainable load
+    while a sparse gold tenant (priority 5, 500 ms TTFT SLO) keeps
+    arriving.  Gold must stay inside its SLO; bulk sheds structured."""
+    duration = 1.2 if smoke() else 6.0
+    burst_start = duration * 0.25
+    burst_len = duration * 0.35
+    bulk = flash_crowd_arrivals(
+        _CAPACITY_RPS * 0.3, _CAPACITY_RPS * 4.0, duration,
+        burst_start, burst_len, seed=seed,
+        classes=[{"tenant": "bulk", "priority": 1, "deadline_ms": 80.0}],
+    )
+    gold = poisson_arrivals(
+        12.0, duration, seed=seed + 1,
+        classes=[{"tenant": "gold", "priority": 5,
+                  "deadline_ms": _GOLD_SLO_MS}],
+    )
+    batcher = _batcher(max_queue=16, ttft_slo_ms=_GOLD_SLO_MS)
+    try:
+        report = LoadGen(_batcher_submit(batcher)).replay(bulk + gold)
+    finally:
+        batcher.drain()
+        snapshot = batcher.slo_snapshot()
+    gold_lat = report["latency_ms"].get("gold/p5", {})
+    gold_bucket = report["tenants"].get("gold/p5", {})
+    report.update(
+        scenario="flash_crowd",
+        burst_rps=round(_CAPACITY_RPS * 4.0, 1),
+        capacity_rps=_CAPACITY_RPS,
+        overload_factor=4.0,
+        gold_slo_ms=_GOLD_SLO_MS,
+        gold_p99_ms=gold_lat.get("p99", 0.0),
+        gold_offered=gold_bucket.get("offered", 0),
+        gold_ok=gold_bucket.get("ok", 0),
+        gold_within_slo=bool(gold_lat)
+        and gold_lat["p99"] <= _GOLD_SLO_MS,
+        slo=snapshot,
+    )
+    return report
+
+
+def _faulted_trace_scenario(seed: int) -> dict:
+    """The flash-crowd trace with seeded ``loadgen.tick`` faults armed:
+    faulted ticks drop offered requests before submission, everything
+    that WAS submitted still settles — degraded load, intact target."""
+    from music_analyst_tpu.resilience import configure_faults, fault_stats
+
+    duration = 0.8 if smoke() else 4.0
+    trace = flash_crowd_arrivals(
+        _CAPACITY_RPS * 0.3, _CAPACITY_RPS * 4.0, duration,
+        duration * 0.25, duration * 0.35, seed=seed,
+        classes=[{"tenant": "bulk", "deadline_ms": 150.0}],
+    )
+    batcher = _batcher(max_queue=16, ttft_slo_ms=_GOLD_SLO_MS)
+    configure_faults(f"loadgen.tick:error@10%seed={seed}")
+    try:
+        report = LoadGen(_batcher_submit(batcher)).replay(trace)
+        trips = fault_stats()["loadgen.tick"]["trips"]
+    finally:
+        configure_faults(None)
+        batcher.drain()
+    report.update(
+        scenario="faulted_trace",
+        spec=f"loadgen.tick:error@10%seed={seed}",
+        trips=trips,
+        trips_match=trips == report["ticks_faulted"],
+    )
+    return report
+
+
+def _preempt_scenario() -> dict:
+    """Preempt-then-resume byte identity on the paged runtime: a gold
+    admit steals the only slot mid-decode; the victim resumes off the
+    radix tree and both answers match the unpreempted run, with zero
+    new compiled programs."""
+    from music_analyst_tpu.models.llama import (
+        LlamaConfig,
+        LlamaZeroShotClassifier,
+    )
+    from music_analyst_tpu.serving.decode_loop import ContinuousScheduler
+
+    clf = LlamaZeroShotClassifier(
+        config=LlamaConfig.tiny(), max_prompt_len=64
+    )
+    sched = ContinuousScheduler(
+        clf, n_slots=1, prefill_chunk=16, prompt_region=64,
+        max_new_tokens=8, max_queue=8, page_size=8, kv_pages=32,
+        ttft_slo_ms=1.0,  # tiny target: a waiting gold admit always steals
+    )
+    sched.warmup()
+    low_prompt = "slow burning ballad of the low priority tenant"
+    high_prompt = "gold tenant chorus arriving mid decode"
+
+    def _run(stage_preempt: bool, tag: str) -> dict:
+        # Explicit generous deadlines: the 1 ms ttft_slo_ms exists to arm
+        # preemption, not to shed this scenario's own requests.
+        low = sched.submit(f"low-{tag}", low_prompt, max_new_tokens=8,
+                           priority=1, deadline_ms=60_000.0)
+        if stage_preempt:
+            # Let the low request occupy the only slot and decode its
+            # first span — mid-flight, not finished — before the gold
+            # arrival shows up.  (Preemption only considers actively
+            # decoding victims, so mid-prefill staging would be a no-op.)
+            for _ in range(32):
+                sched._tick()
+                slot = sched._slots[0]
+                if slot is not None and slot.active and slot.steps > 0:
+                    break
+        high = sched.submit(f"high-{tag}", high_prompt, max_new_tokens=8,
+                            priority=5, deadline_ms=60_000.0)
+        sched.run_until_idle()
+        for req in (low, high):
+            resp = req.response or {}
+            if not resp.get("ok"):
+                raise RuntimeError(f"{req.id} failed: {resp.get('error')}")
+        return {"low": low.response["text"], "high": high.response["text"]}
+
+    start = time.perf_counter()
+    clean = _run(stage_preempt=False, tag="clean")
+    variants_before = sched.runtime.compiled_variants()
+    preempted = _run(stage_preempt=True, tag="preempt")
+    elapsed = time.perf_counter() - start
+    stats = sched.stats()
+    return {
+        "scenario": "preempt_resume",
+        "preemptions": stats["preemptions"],
+        "resumed": stats["resumed"],
+        "bytes_identical": preempted == clean,
+        "compiled_variants": stats["compiled_variants"],
+        "retraces": sched.runtime.compiled_variants() - variants_before,
+        "wall_s": round(elapsed, 4),
+        "slo": sched.slo_snapshot(),
+    }
+
+
+@suite("slo")
+def run() -> dict:
+    seed = 42
+    steady = _steady_scenario(seed)
+    print(f"[slo] steady: ok={steady['ok']}/{steady['offered']} "
+          f"clean={steady['clean']}", file=sys.stderr)
+    diurnal = _diurnal_scenario(seed)
+    print(f"[slo] diurnal: ok={diurnal['ok']} shed={diurnal['shed']} "
+          f"structured={diurnal['sheds_structured']}", file=sys.stderr)
+    flash = _flash_crowd_scenario(seed)
+    print(f"[slo] flash_crowd: gold p99={flash['gold_p99_ms']}ms "
+          f"(SLO {flash['gold_slo_ms']}ms) within={flash['gold_within_slo']} "
+          f"shed={flash['shed']}", file=sys.stderr)
+    faulted = _faulted_trace_scenario(seed)
+    print(f"[slo] faulted_trace: ticks_faulted={faulted['ticks_faulted']} "
+          f"silent={faulted['silent_drops']}", file=sys.stderr)
+    preempt = _preempt_scenario()
+    print(f"[slo] preempt_resume: preemptions={preempt['preemptions']} "
+          f"identical={preempt['bytes_identical']} "
+          f"retraces={preempt['retraces']}", file=sys.stderr)
+    scenarios = [steady, diurnal, flash, faulted]
+    return {
+        "suite": "slo",
+        "device": device_info(),
+        "smoke": smoke(),
+        "capacity_rps": _CAPACITY_RPS,
+        "scenarios": scenarios,
+        "preempt": preempt,
+        "gold_within_slo": flash["gold_within_slo"],
+        "all_sheds_structured": all(
+            s["sheds_structured"] for s in scenarios
+        ),
+        "zero_silent_drops": all(
+            s["silent_drops"] == 0 for s in scenarios
+        ),
+        "preempt_bytes_identical": preempt["bytes_identical"],
+        "zero_retraces": preempt["retraces"] == 0,
+    }

@@ -344,6 +344,27 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                         "heads + KV cache shard over a tp mesh axis "
                         "(must divide kv heads; default "
                         "$MUSICAAL_SERVE_TP or 1)")
+    p.add_argument("--ttft-slo-ms", type=float, default=None,
+                   help="Time-to-first-token target in ms: arms SLO-aware "
+                        "preemption (a waiting higher-priority admit may "
+                        "slot-steal) and deadline-aware shedding "
+                        "(slo_unattainable); 0 disables (default "
+                        "$MUSICAAL_SERVE_SLO_TTFT_MS or 0)")
+    p.add_argument("--tpot-slo-ms", type=float, default=None,
+                   help="Time-per-output-token target in ms: the decode "
+                        "loop defers low-priority admits while the "
+                        "per-token EWMA is over target; 0 disables "
+                        "(default $MUSICAAL_SERVE_SLO_TPOT_MS or 0)")
+    p.add_argument("--tenant-budget", type=float, default=None,
+                   help="Per-tenant admission budget in requests/second "
+                        "(token bucket, burst 2x); an over-budget tenant "
+                        "sheds at its own bucket while others keep "
+                        "admitting; 0 disables (default "
+                        "$MUSICAAL_SERVE_TENANT_BUDGET or 0)")
+    p.add_argument("--priority", type=int, default=None,
+                   help="Default priority class for requests that don't "
+                        "carry one on the wire (higher serves first; "
+                        "default $MUSICAAL_SERVE_PRIORITY or 1)")
     p.add_argument("--no-warmup", action="store_true",
                    help="Skip the startup warmup batches (first request "
                         "pays compile cost)")
@@ -616,6 +637,10 @@ def _dispatch(parser: argparse.ArgumentParser,
                 page_size=args.page_size,
                 kv_pages=args.kv_pages,
                 tp=args.tp,
+                ttft_slo_ms=args.ttft_slo_ms,
+                tpot_slo_ms=args.tpot_slo_ms,
+                tenant_budget=args.tenant_budget,
+                priority=args.priority,
             )
             if resolve_replicas(args.replicas) > 1:
                 from music_analyst_tpu.serving.router import run_router

@@ -376,6 +376,7 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 "dispatched": router.get("dispatched"),
                 "requeued": router.get("requeued"),
                 "shed": router.get("shed"),
+                "respawned": router.get("respawns"),
                 "health_transitions": len(
                     router.get("health_transitions") or []
                 ),
@@ -383,6 +384,7 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                     name: {
                         "dispatched": snap.get("dispatched"),
                         "requeues": snap.get("requeues"),
+                        "respawns": snap.get("respawns"),
                         "health": snap.get("health"),
                     }
                     for name, snap in (router.get("replicas") or {}).items()
@@ -474,6 +476,7 @@ def render_report(report: Dict[str, Any]) -> List[str]:
                 f"  {fleet['label']}: {fleet['replica_count']} replica(s), "
                 f"{fleet['dispatched']} dispatched, "
                 f"{fleet['requeued']} requeued, "
+                f"{fleet['respawned'] or 0} respawned, "
                 f"{fleet['health_transitions']} health transition(s)"
             )
             for name, snap in (fleet["replicas"] or {}).items():

@@ -58,6 +58,8 @@ SITES = frozenset(
         "checkpoint.load",
         "kv_pages.lookup",
         "router.dispatch",
+        "scheduler.preempt",
+        "loadgen.tick",
     }
 )
 

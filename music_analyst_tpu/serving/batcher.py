@@ -19,6 +19,17 @@ so a poison request fails alone (structured ``request_failed`` carrying
 its id) and its batchmates still get answers; the server never dies with
 the batch.
 
+Overload is a *scheduled* state, not an error path (``serving/slo.py``):
+requests carry a tenant, a priority class, and an optional deadline; each
+op queue is a :class:`~music_analyst_tpu.serving.slo.FairQueue` (strict
+priority classes, per-tenant weighted fair queueing inside a class), a
+per-tenant :class:`~music_analyst_tpu.serving.slo.TokenBucket` meters
+admission when ``--tenant-budget`` is set, a full queue evicts
+lower-priority / over-represented work before shedding a newcomer, and a
+request whose deadline the EWMA drain estimate already blows sheds with
+``slo_unattainable`` instead of joining a queue it cannot survive.  Every
+shed carries the ``retry_after_ms`` hint.
+
 Everything is mirrored into telemetry (``serving.*`` counters, queue
 depth / occupancy gauges, latency histograms with p50/p95/p99) and into
 a local stats dict the run manifest's ``serving`` section snapshots.
@@ -30,13 +41,13 @@ import math
 import os
 import threading
 import time
-from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from music_analyst_tpu.observability import watchdog
 from music_analyst_tpu.resilience.failover import should_failover
 from music_analyst_tpu.resilience.faults import fault_point
 from music_analyst_tpu.resilience.policy import RetryPolicy
+from music_analyst_tpu.serving.slo import FairQueue, TokenBucket
 from music_analyst_tpu.telemetry import get_telemetry
 from music_analyst_tpu.telemetry.core import Histogram
 from music_analyst_tpu.utils.shapes import round_pow2
@@ -61,6 +72,15 @@ DEFAULT_KV_PAGES = 0
 # router, and tensor-parallel width within each worker's decode runtime.
 DEFAULT_REPLICAS = 1
 DEFAULT_TP = 1
+# SLO/overload layer (serving/slo.py): TTFT/TPOT targets the scheduler
+# acts on (0 disables — no preemption, no deadline shedding), per-tenant
+# sustained admission budget in requests/second (0 = unmetered), and the
+# priority class assigned to wire requests that don't carry one.
+DEFAULT_TTFT_SLO_MS = 0.0
+DEFAULT_TPOT_SLO_MS = 0.0
+DEFAULT_TENANT_BUDGET = 0.0
+DEFAULT_PRIORITY = 1
+DEFAULT_TENANT = "default"
 # Bounds on the ``retry_after_ms`` hint a queue_full shed carries: never
 # tell a client to come back sooner than one flush deadline, never park
 # it for more than half a minute on a stale rate estimate.
@@ -174,6 +194,38 @@ def resolve_tp(value: Any = None) -> int:
                         DEFAULT_TP, integer=True, minimum=1))
 
 
+def resolve_ttft_slo_ms(value: Any = None) -> float:
+    """Time-to-first-token target (``--ttft-slo-ms`` /
+    ``$MUSICAAL_SERVE_SLO_TTFT_MS``).  0 disables SLO enforcement: no
+    preemption, no deadline-derived shedding."""
+    return _resolve(value, "MUSICAAL_SERVE_SLO_TTFT_MS",
+                    DEFAULT_TTFT_SLO_MS, integer=False, minimum=0.0)
+
+
+def resolve_tpot_slo_ms(value: Any = None) -> float:
+    """Per-output-token latency target (``--tpot-slo-ms`` /
+    ``$MUSICAAL_SERVE_SLO_TPOT_MS``).  0 disables the decode scheduler's
+    admission throttle."""
+    return _resolve(value, "MUSICAAL_SERVE_SLO_TPOT_MS",
+                    DEFAULT_TPOT_SLO_MS, integer=False, minimum=0.0)
+
+
+def resolve_tenant_budget(value: Any = None) -> float:
+    """Per-tenant sustained admission budget in requests/second
+    (``--tenant-budget`` / ``$MUSICAAL_SERVE_TENANT_BUDGET``).  0 leaves
+    tenants unmetered (fair queueing still applies)."""
+    return _resolve(value, "MUSICAAL_SERVE_TENANT_BUDGET",
+                    DEFAULT_TENANT_BUDGET, integer=False, minimum=0.0)
+
+
+def resolve_priority(value: Any = None) -> int:
+    """Default priority class for requests that don't carry one
+    (``--priority`` / ``$MUSICAAL_SERVE_PRIORITY``; higher serves
+    first)."""
+    return int(_resolve(value, "MUSICAAL_SERVE_PRIORITY",
+                        DEFAULT_PRIORITY, integer=True, minimum=0))
+
+
 def resolve_kv_pages(value: Any = None, n_slots: Optional[int] = None) -> int:
     """KV pool size in pages (``--kv-pages`` /
     ``$MUSICAAL_SERVE_KV_PAGES``).
@@ -203,22 +255,33 @@ class ServeRequest:
     entirely in the ``id`` the client supplied.
     """
 
-    __slots__ = ("id", "op", "text", "t_enqueue", "_done", "response",
-                 "meta")
+    __slots__ = ("id", "op", "text", "t_enqueue", "t_settle", "_done",
+                 "response", "meta", "tenant", "priority", "deadline_ms")
 
     def __init__(self, rid: Any, op: str, text: str,
-                 meta: Optional[Dict[str, Any]] = None) -> None:
+                 meta: Optional[Dict[str, Any]] = None,
+                 tenant: str = DEFAULT_TENANT,
+                 priority: int = DEFAULT_PRIORITY,
+                 deadline_ms: Optional[float] = None) -> None:
         self.id = rid
         self.op = op
         self.text = text
         self.t_enqueue = time.monotonic()
+        self.t_settle: Optional[float] = None
         self._done = threading.Event()
         self.response: Optional[Dict[str, Any]] = None
         # Per-request knobs outside the batch contract (e.g. the decode
         # loop's max_new_tokens budget); the dynamic batcher ignores it.
         self.meta: Dict[str, Any] = meta or {}
+        # SLO/isolation identity (serving/slo.py): fair-queue tenant,
+        # strict priority class (higher first), optional arrival-relative
+        # deadline the admission estimate is checked against.
+        self.tenant = tenant
+        self.priority = int(priority)
+        self.deadline_ms = deadline_ms
 
     def complete(self, payload: Dict[str, Any]) -> None:
+        self.t_settle = time.monotonic()
         self.response = payload
         self._done.set()
 
@@ -262,6 +325,9 @@ class DynamicBatcher:
         max_queue: Optional[int] = None,
         name: str = "serve",
         failover: Optional[Callable[[BaseException], bool]] = None,
+        ttft_slo_ms: Optional[float] = None,
+        tenant_budget: Optional[float] = None,
+        priority: Optional[int] = None,
     ) -> None:
         self._ops = dict(ops)
         # Classified device loss during dispatch tries this hook ONCE per
@@ -277,7 +343,13 @@ class DynamicBatcher:
         self.max_wait_ms = resolve_max_wait_ms(max_wait_ms)
         self.max_queue = resolve_max_queue(max_queue)
         self.name = name
-        self._queues: Dict[str, deque] = {op: deque() for op in self._ops}
+        self.ttft_slo_ms = resolve_ttft_slo_ms(ttft_slo_ms)
+        self.tenant_budget = resolve_tenant_budget(tenant_budget)
+        self.default_priority = resolve_priority(priority)
+        self._queues: Dict[str, FairQueue] = {
+            op: FairQueue() for op in self._ops
+        }
+        self._buckets: Dict[str, TokenBucket] = {}
         self._cond = threading.Condition()
         self._draining = False
         self._thread: Optional[threading.Thread] = None
@@ -290,7 +362,11 @@ class DynamicBatcher:
             "queue_depth_max": 0, "isolation_retries": 0,
             "failover_reloads": 0, "dedup_folded": 0,
             "retry_after_ms_last": None,
+            "shed_queue_full": 0, "shed_slo_unattainable": 0,
+            "shed_tenant_budget": 0, "shed_evicted": 0,
         }
+        # Per-tenant admission ledger (manifest ``serving.slo`` section).
+        self._tenants: Dict[str, Dict[str, int]] = {}
         # EWMA of observed flush throughput (rows/s) — feeds the
         # ``retry_after_ms`` hint a queue_full shed carries.
         self._flush_rate = 0.0
@@ -326,11 +402,28 @@ class DynamicBatcher:
 
     # ----------------------------------------------------------- admission
 
-    def submit(self, rid: Any, op: str, text: str) -> ServeRequest:
+    def submit(self, rid: Any, op: str, text: str,
+               tenant: Optional[str] = None,
+               priority: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> ServeRequest:
         """Admit (or shed) one request; always returns a ServeRequest —
-        a shed one is already completed with its structured error."""
+        a shed one is already completed with its structured error.
+
+        ``tenant``/``priority`` place the request in its fair queue;
+        ``deadline_ms`` (arrival-relative; defaults to the configured
+        TTFT SLO when one is set) arms deadline-aware shedding: a
+        request whose drain estimate already blows its deadline sheds
+        ``slo_unattainable`` instead of queueing to miss.
+        """
         tel = get_telemetry()
-        req = ServeRequest(rid, op, text)
+        if deadline_ms is None and self.ttft_slo_ms > 0.0:
+            deadline_ms = self.ttft_slo_ms
+        req = ServeRequest(
+            rid, op, text,
+            tenant=tenant or DEFAULT_TENANT,
+            priority=self.default_priority if priority is None else priority,
+            deadline_ms=deadline_ms,
+        )
         if op not in self._ops:
             req.fail(
                 "bad_request",
@@ -341,33 +434,111 @@ class DynamicBatcher:
         with self._cond:
             if self._draining:
                 req.fail("draining", "server is draining; not admitting")
-                self._bump(shed=1)
-                tel.count("serving.shed")
+                self._shed(req, "draining", None)
                 return req
+            # Per-tenant token bucket: the saturating tenant sheds at its
+            # OWN budget while everyone else keeps admitting.
+            if self.tenant_budget > 0.0:
+                bucket = self._buckets.get(req.tenant)
+                if bucket is None:
+                    bucket = self._buckets[req.tenant] = TokenBucket(
+                        self.tenant_budget
+                    )
+                if not bucket.take():
+                    hint_ms = max(
+                        bucket.retry_after_ms(), self.retry_after_ms(1)
+                    )
+                    req.fail(
+                        "queue_full",
+                        f"tenant {req.tenant!r} over its admission budget "
+                        f"({self.tenant_budget:g} req/s); retry after "
+                        f"{hint_ms:.0f} ms",
+                        retry_after_ms=hint_ms,
+                    )
+                    self._shed(req, "shed_tenant_budget", hint_ms)
+                    return req
+            queue = self._queues[op]
+            # Deadline check BEFORE capacity: a request the drain
+            # estimate already dooms must not evict anyone.
+            if req.deadline_ms is not None and req.deadline_ms > 0.0:
+                est_ms = self._drain_estimate_ms(queue, req.priority)
+                if est_ms is not None and est_ms > req.deadline_ms:
+                    hint_ms = self.retry_after_ms()
+                    req.fail(
+                        "slo_unattainable",
+                        f"drain estimate {est_ms:.0f} ms already exceeds "
+                        f"the {req.deadline_ms:.0f} ms deadline; retry "
+                        f"after {hint_ms:.0f} ms",
+                        retry_after_ms=hint_ms,
+                        estimate_ms=round(est_ms, 3),
+                    )
+                    self._shed(req, "shed_slo_unattainable", hint_ms)
+                    return req
             depth = sum(len(q) for q in self._queues.values())
             if depth >= self.max_queue:
+                # Priority-aware eviction: shed queued lower-priority /
+                # over-represented work before the newcomer.
+                victim = queue.shed_candidate(req.tenant, req.priority)
                 hint_ms = self.retry_after_ms(depth)
-                req.fail(
+                if victim is None:
+                    req.fail(
+                        "queue_full",
+                        f"admission queue full ({depth}/{self.max_queue}); "
+                        f"retry after {hint_ms:.0f} ms",
+                        retry_after_ms=hint_ms,
+                    )
+                    self._shed(req, "shed_queue_full", hint_ms)
+                    return req
+                victim.fail(
                     "queue_full",
-                    f"admission queue full ({depth}/{self.max_queue}); "
-                    f"retry after {hint_ms:.0f} ms",
+                    f"evicted for a priority-{req.priority} admit with the "
+                    f"queue full ({depth}/{self.max_queue}); retry after "
+                    f"{hint_ms:.0f} ms",
                     retry_after_ms=hint_ms,
                 )
-                with self._stats_lock:
-                    self._stats["shed"] += 1
-                    self._stats["retry_after_ms_last"] = hint_ms
-                tel.count("serving.shed")
-                return req
-            self._queues[op].append(req)
-            depth += 1
+                self._shed(victim, "shed_evicted", hint_ms)
+            queue.append(req)
+            depth = sum(len(q) for q in self._queues.values())
             self._cond.notify_all()
         with self._stats_lock:
             self._stats["admitted"] += 1
+            self._tenant_ledger(req.tenant)["admitted"] += 1
             if depth > self._stats["queue_depth_max"]:
                 self._stats["queue_depth_max"] = depth
         tel.count("serving.admitted")
         tel.gauge("serving.queue_depth", depth)
         return req
+
+    def _tenant_ledger(self, tenant: str) -> Dict[str, int]:
+        """Caller holds ``_stats_lock``."""
+        ledger = self._tenants.get(tenant)
+        if ledger is None:
+            ledger = self._tenants[tenant] = {
+                "admitted": 0, "completed": 0, "shed": 0,
+            }
+        return ledger
+
+    def _shed(self, req: ServeRequest, kind_stat: Optional[str],
+              hint_ms: Optional[float]) -> None:
+        with self._stats_lock:
+            self._stats["shed"] += 1
+            if kind_stat in self._stats:
+                self._stats[kind_stat] += 1
+            if hint_ms is not None:
+                self._stats["retry_after_ms_last"] = hint_ms
+            self._tenant_ledger(req.tenant)["shed"] += 1
+        get_telemetry().count("serving.shed")
+
+    def _drain_estimate_ms(self, queue: FairQueue,
+                           priority: int) -> Optional[float]:
+        """EWMA time estimate until a newcomer at ``priority`` would
+        dispatch (caller holds cond).  None before the first flush — no
+        rate observation means no grounds to shed on."""
+        rate = self._flush_rate
+        if rate <= 0.0:
+            return None
+        ahead = queue.depth_ahead(priority)
+        return ahead / rate * 1000.0 + max(self.max_wait_ms, 1.0)
 
     def _bump(self, **deltas: int) -> None:
         with self._stats_lock:
@@ -395,11 +566,14 @@ class DynamicBatcher:
     # -------------------------------------------------------------- worker
 
     def _oldest_op(self) -> Optional[str]:
-        """Op whose head request has waited longest (caller holds cond)."""
+        """Op whose oldest queued request has waited longest (caller
+        holds cond).  The flush deadline honors the oldest request even
+        when the fair queue would dispatch a different one first."""
         best: Optional[Tuple[float, str]] = None
         for op, q in self._queues.items():
-            if q and (best is None or q[0].t_enqueue < best[0]):
-                best = (q[0].t_enqueue, op)
+            oldest = q.head_wait_t()
+            if oldest is not None and (best is None or oldest < best[0]):
+                best = (oldest, op)
         return best[1] if best else None
 
     def _next_batch(self) -> Tuple[Optional[str], List[ServeRequest]]:
@@ -414,13 +588,16 @@ class DynamicBatcher:
                     self._cond.wait(0.05)
                     continue
                 q = self._queues[op]
-                waited_ms = (time.monotonic() - q[0].t_enqueue) * 1000.0
+                waited_ms = (
+                    time.monotonic() - q.head_wait_t()
+                ) * 1000.0
                 if (len(q) >= self.max_batch or self._draining
                         or waited_ms >= self.max_wait_ms):
-                    batch = [
-                        q.popleft()
-                        for _ in range(min(len(q), self.max_batch))
-                    ]
+                    batch = []
+                    for _ in range(min(len(q), self.max_batch)):
+                        picked = q.popleft()
+                        if picked is not None:
+                            batch.append(picked)
                     return op, batch
                 remaining_s = (self.max_wait_ms - waited_ms) / 1000.0
                 self._cond.wait(min(max(remaining_s, 0.001), 0.05))
@@ -533,6 +710,7 @@ class DynamicBatcher:
             self._occupancy.observe(occupancy)
             for req in batch:
                 self._latency.observe(now - req.t_enqueue)
+                self._tenant_ledger(req.tenant)["completed"] += 1
             # Flush-rate EWMA feeding retry_after_ms: requests retired per
             # wall second, smoothed so one anomalous batch can't swing the
             # backoff hint an order of magnitude.
@@ -582,3 +760,30 @@ class DynamicBatcher:
             batch_occupancy_hist=occ,
         )
         return out
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """The manifest's ``serving.slo`` contribution: targets, shed
+        taxonomy, and the per-tenant ledger.  Empty when the SLO layer
+        was neither configured nor exercised (only-when-used, like the
+        corpus-cache section)."""
+        with self._stats_lock:
+            tenants = {t: dict(v) for t, v in self._tenants.items()}
+            sheds = {
+                key: self._stats[key]
+                for key in ("shed_queue_full", "shed_slo_unattainable",
+                            "shed_tenant_budget", "shed_evicted")
+            }
+        configured = self.ttft_slo_ms > 0.0 or self.tenant_budget > 0.0
+        exercised = (
+            any(sheds.values())
+            or any(t != DEFAULT_TENANT for t in tenants)
+        )
+        if not configured and not exercised:
+            return {}
+        return {
+            "ttft_slo_ms": self.ttft_slo_ms,
+            "tenant_budget_req_s": self.tenant_budget,
+            "default_priority": self.default_priority,
+            "sheds": sheds,
+            "tenants": tenants,
+        }

@@ -37,13 +37,32 @@ Telemetry: slot-occupancy gauge + histogram, tokens/s, and TTFT/TPOT
 reservoir quantiles (``serving.ttft_seconds`` / ``serving.tpot_seconds``
 land in the run manifest next to the batcher's latency quantiles, where
 ``telemetry-report`` picks them up).
+
+SLO enforcement (``serving/slo.py``): the admission queue is a
+:class:`FairQueue` (strict priority classes, per-tenant WFQ) with
+per-tenant token buckets and the batcher's full shed contract
+(``queue_full`` / ``slo_unattainable``, each carrying ``retry_after_ms``).
+When a TTFT target is configured (``--ttft-slo-ms``) and a waiting
+higher-priority admit would miss it, the scheduler **preempts**: it
+slot-steals from the longest-running strictly-lower-priority decode —
+the victim's fully-prefilled prompt pages are first adopted into the
+radix tree, its slot is released through the normal host-side free path
+(no device zeroing: nothing faulted, so the reuse invariants hold), and
+the original request is requeued at the head of its tenant queue.
+Resume is then a prefix hit plus the boundary/final chunk re-prefill;
+greedy decode is deterministic, so the preempted-then-resumed tokens are
+byte-identical to the undisturbed run at zero retraces.  An injected
+``scheduler.preempt`` fault aborts the steal BEFORE any state mutation —
+the degraded mode is "no steal this tick", never a half-zeroed slot.  A
+TPOT target (``--tpot-slo-ms``) throttles new admissions while the
+per-token EWMA is over target, shrinking the multiprogramming level
+instead of letting every resident stream miss together.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from collections import deque
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -55,13 +74,20 @@ from music_analyst_tpu.resilience.policy import RetryPolicy
 from music_analyst_tpu.serving.batcher import (
     _LATENCY_BUCKETS,
     _OCCUPANCY_BUCKETS,
+    _RETRY_AFTER_CAP_MS,
+    DEFAULT_TENANT,
     ServeRequest,
     resolve_kv_pages,
     resolve_max_queue,
     resolve_page_size,
     resolve_prefill_chunk,
+    resolve_priority,
     resolve_slots,
+    resolve_tenant_budget,
+    resolve_tpot_slo_ms,
+    resolve_ttft_slo_ms,
 )
+from music_analyst_tpu.serving.slo import FairQueue, TokenBucket
 from music_analyst_tpu.telemetry import get_telemetry
 from music_analyst_tpu.telemetry.core import Histogram
 from music_analyst_tpu.utils.labels import normalise_label
@@ -120,11 +146,19 @@ class ContinuousScheduler:
         page_size: Optional[int] = None,
         kv_pages: Optional[int] = None,
         prefix_cache: bool = True,
+        ttft_slo_ms: Optional[float] = None,
+        tpot_slo_ms: Optional[float] = None,
+        tenant_budget: Optional[float] = None,
+        priority: Optional[int] = None,
     ) -> None:
         self.backend = backend
         self.n_slots = resolve_slots(n_slots)
         self.prefill_chunk = resolve_prefill_chunk(prefill_chunk)
         self.max_queue = resolve_max_queue(max_queue)
+        self.ttft_slo_ms = resolve_ttft_slo_ms(ttft_slo_ms)
+        self.tpot_slo_ms = resolve_tpot_slo_ms(tpot_slo_ms)
+        self.tenant_budget = resolve_tenant_budget(tenant_budget)
+        self.default_priority = resolve_priority(priority)
         page = resolve_page_size(page_size)
         self.paged = bool(page) and hasattr(backend, "paged_runtime")
         if self.paged:
@@ -171,7 +205,8 @@ class ContinuousScheduler:
             self._table = None
             self._prefix = {}
         self._slots: List[Optional[_Slot]] = [None] * self.plan.n_slots
-        self._queue: deque = deque()
+        self._queue = FairQueue()
+        self._buckets: Dict[str, TokenBucket] = {}
         self._cond = threading.Condition()
         self._draining = False
         self._thread: Optional[threading.Thread] = None
@@ -185,7 +220,19 @@ class ContinuousScheduler:
             "tokens_generated": 0, "prefill_dispatches": 0,
             "decode_dispatches": 0, "decode_seconds": 0.0,
             "queue_depth_max": 0,
+            "preemptions": 0, "preempt_faults": 0, "resumed": 0,
+            "tpot_throttle_ticks": 0, "ttft_slo_misses": 0,
+            "tpot_slo_misses": 0, "retry_after_ms_last": None,
+            "shed_queue_full": 0, "shed_slo_unattainable": 0,
+            "shed_tenant_budget": 0, "shed_evicted": 0,
         }
+        # Per-tenant admission ledger (manifest ``serving.slo`` section).
+        self._tenants: Dict[str, Dict[str, int]] = {}
+        # TTFT/TPOT EWMAs (seconds): the drain estimate behind
+        # ``slo_unattainable`` sheds and the TPOT admission throttle.
+        self._ttft_ewma_s = 0.0
+        self._tpot_ewma_s = 0.0
+        self._t_started = time.monotonic()
         self._warmup_record: Optional[Dict[str, Any]] = None
 
     # ----------------------------------------------------------- lifecycle
@@ -316,38 +363,160 @@ class ContinuousScheduler:
     # ----------------------------------------------------------- admission
 
     def submit(self, rid: Any, text: str, op: str = "generate",
-               max_new_tokens: Optional[int] = None) -> ServeRequest:
+               max_new_tokens: Optional[int] = None,
+               tenant: Optional[str] = None,
+               priority: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> ServeRequest:
         """Admit (or shed) one generation request; mirrors the batcher's
-        bounded-admission contract."""
+        bounded-admission contract, including the full SLO shed ladder
+        (token bucket → ``slo_unattainable`` → priority-aware eviction →
+        ``queue_full``), every shed carrying ``retry_after_ms``."""
         tel = get_telemetry()
         budget = int(max_new_tokens or self.plan.max_new)
         budget = max(1, min(budget, self.plan.max_new))
-        req = ServeRequest(rid, op, text, meta={"max_new_tokens": budget})
+        if deadline_ms is None and self.ttft_slo_ms > 0.0:
+            deadline_ms = self.ttft_slo_ms
+        req = ServeRequest(
+            rid, op, text, meta={"max_new_tokens": budget},
+            tenant=tenant or DEFAULT_TENANT,
+            priority=(
+                self.default_priority if priority is None else int(priority)
+            ),
+            deadline_ms=deadline_ms,
+        )
         with self._cond:
             if self._draining:
                 req.fail("draining", "server is draining; not admitting")
-                self._bump(shed=1)
-                tel.count("serving.shed")
+                self._shed(req, None, None)
                 return req
+            # Per-tenant token bucket: the saturating tenant sheds at its
+            # OWN budget while everyone else keeps admitting.
+            if self.tenant_budget > 0.0:
+                bucket = self._buckets.get(req.tenant)
+                if bucket is None:
+                    bucket = self._buckets[req.tenant] = TokenBucket(
+                        self.tenant_budget
+                    )
+                if not bucket.take():
+                    hint_ms = max(
+                        bucket.retry_after_ms(), self.retry_after_ms(1)
+                    )
+                    req.fail(
+                        "queue_full",
+                        f"tenant {req.tenant!r} over its admission budget "
+                        f"({self.tenant_budget:g} req/s); retry after "
+                        f"{hint_ms:.0f} ms",
+                        retry_after_ms=hint_ms,
+                    )
+                    self._shed(req, "shed_tenant_budget", hint_ms)
+                    return req
+            # Deadline check BEFORE capacity: a request the drain
+            # estimate already dooms must not evict anyone.
+            if req.deadline_ms is not None and req.deadline_ms > 0.0:
+                est_ms = self._ttft_estimate_ms(req.priority)
+                if est_ms is not None and est_ms > req.deadline_ms:
+                    hint_ms = self.retry_after_ms(len(self._queue))
+                    req.fail(
+                        "slo_unattainable",
+                        f"TTFT estimate {est_ms:.0f} ms already exceeds "
+                        f"the {req.deadline_ms:.0f} ms deadline; retry "
+                        f"after {hint_ms:.0f} ms",
+                        retry_after_ms=hint_ms,
+                        estimate_ms=round(est_ms, 3),
+                    )
+                    self._shed(req, "shed_slo_unattainable", hint_ms)
+                    return req
             depth = len(self._queue)
             if depth >= self.max_queue:
-                req.fail(
+                # Priority-aware eviction: shed queued lower-priority /
+                # over-represented work before the newcomer.
+                victim = self._queue.shed_candidate(req.tenant, req.priority)
+                hint_ms = self.retry_after_ms(depth)
+                if victim is None:
+                    req.fail(
+                        "queue_full",
+                        f"decode admission queue full "
+                        f"({depth}/{self.max_queue}); retry after "
+                        f"{hint_ms:.0f} ms",
+                        retry_after_ms=hint_ms,
+                    )
+                    self._shed(req, "shed_queue_full", hint_ms)
+                    return req
+                victim.fail(
                     "queue_full",
-                    f"decode admission queue full ({depth}/{self.max_queue});"
-                    " retry with backoff",
+                    f"evicted for a priority-{req.priority} admit with the "
+                    f"queue full ({depth}/{self.max_queue}); retry after "
+                    f"{hint_ms:.0f} ms",
+                    retry_after_ms=hint_ms,
                 )
-                self._bump(shed=1)
-                tel.count("serving.shed")
-                return req
+                self._shed(victim, "shed_evicted", hint_ms)
             self._queue.append(req)
-            depth += 1
+            depth = len(self._queue)
             self._cond.notify_all()
         with self._stats_lock:
             self._stats["admitted"] += 1
+            self._tenant_ledger(req.tenant)["admitted"] += 1
             if depth > self._stats["queue_depth_max"]:
                 self._stats["queue_depth_max"] = depth
         tel.count("serving.decode_admitted")
         return req
+
+    def _tenant_ledger(self, tenant: str) -> Dict[str, int]:
+        """Caller holds ``_stats_lock``."""
+        ledger = self._tenants.get(tenant)
+        if ledger is None:
+            ledger = self._tenants[tenant] = {
+                "admitted": 0, "completed": 0, "shed": 0,
+            }
+        return ledger
+
+    def _shed(self, req: ServeRequest, kind_stat: Optional[str],
+              hint_ms: Optional[float]) -> None:
+        with self._stats_lock:
+            self._stats["shed"] += 1
+            if kind_stat in self._stats:
+                self._stats[kind_stat] += 1
+            if hint_ms is not None:
+                self._stats["retry_after_ms_last"] = hint_ms
+            self._tenant_ledger(req.tenant)["shed"] += 1
+        get_telemetry().count("serving.shed")
+
+    def _settle_rate(self) -> float:
+        """Observed settle throughput (requests/s since construction) —
+        the denominator of the retry hint and the TTFT drain estimate."""
+        with self._stats_lock:
+            settled = self._stats["completed"] + self._stats["failed"]
+        elapsed = time.monotonic() - self._t_started
+        return settled / elapsed if elapsed > 0.0 and settled else 0.0
+
+    def retry_after_ms(self, depth: Optional[int] = None) -> float:
+        """Backoff hint for a shed client: estimated time to drain the
+        queue ahead at the observed settle rate, floored at 1 ms and
+        capped so a stale estimate can't park clients for minutes.
+        Before the first settle there is no rate — fall back to a
+        per-queued-request pessimistic constant."""
+        if depth is None:
+            with self._cond:
+                depth = len(self._queue)
+        rate = self._settle_rate()
+        if rate > 0.0:
+            hint = (depth + 1) / rate * 1000.0
+        else:
+            hint = 50.0 * max(depth, 1)
+        return round(min(max(hint, 1.0), _RETRY_AFTER_CAP_MS), 3)
+
+    def _ttft_estimate_ms(self, priority: int) -> Optional[float]:
+        """EWMA estimate of a newcomer's TTFT at ``priority`` (caller
+        holds cond): queue-drain time ahead of it plus the observed
+        prefill latency.  None before the first completion — no
+        observation means no grounds to shed on."""
+        rate = self._settle_rate()
+        with self._stats_lock:
+            ttft_ewma_s = self._ttft_ewma_s
+        if rate <= 0.0 or ttft_ewma_s <= 0.0:
+            return None
+        ahead = self._queue.depth_ahead(priority)
+        return ahead / rate * 1000.0 + ttft_ewma_s * 1000.0
 
     def _bump(self, **deltas: Any) -> None:
         with self._stats_lock:
@@ -395,15 +564,28 @@ class ContinuousScheduler:
     def _admit(self) -> bool:
         did = False
         while True:
+            with self._cond:
+                head = self._queue.peek()
+                if head is not None and head.done:
+                    # Settled while queued (shouldn't normally happen —
+                    # eviction removes its victim): discard and move on.
+                    self._queue.popleft()
+                    continue
+            if head is None:
+                return did
             free = next(
                 (i for i, s in enumerate(self._slots) if s is None), None
             )
             if free is None:
+                free = self._maybe_preempt()
+                if free is None:
+                    return did
+            elif self._tpot_throttled(head):
                 return did
             with self._cond:
-                if not self._queue:
-                    return did
                 req = self._queue.popleft()
+            if req is None:
+                return did
             if req.done:  # already shed/settled
                 continue
             try:
@@ -425,13 +607,100 @@ class ContinuousScheduler:
                 # request back and stop admitting this tick — in-flight
                 # sequences completing will release pages.
                 with self._cond:
-                    self._queue.appendleft(req)
+                    self._queue.requeue(req)
                 with self._stats_lock:
                     self._prefix["deferred"] += 1
                 return did
             self._slots[free] = slot
             did = True
         return did
+
+    def _maybe_preempt(self) -> Optional[int]:
+        """Slot-steal for a waiting higher-priority admit that would miss
+        its TTFT target; returns the freed slot index, or None ("no steal
+        this tick").
+
+        Victim = the longest-running decode in the lowest priority class
+        strictly below the queue head's.  The injected-fault gate
+        (``scheduler.preempt``) sits BEFORE any state mutation, so a
+        fault degrades to no steal at all — never a half-released slot.
+        The steal itself is the normal completion path run early: adopt
+        the fully-prefilled prompt pages into the radix tree, requeue
+        the request at the head of its tenant queue, release the slot
+        host-side (no device zeroing — nothing faulted, so the reuse
+        invariants hold).  Resume re-runs the request from scratch
+        (prefix hit + boundary chunk on the paged backend, full prefill
+        on the monolithic one); greedy decode is deterministic, so the
+        resumed tokens are byte-identical to an undisturbed run.
+        """
+        if self.ttft_slo_ms <= 0.0:
+            return None
+        with self._cond:
+            head = self._queue.peek()
+            if head is None or head.done:
+                return None
+            est_ms = self._ttft_estimate_ms(head.priority)
+        candidates = [
+            (s.req.priority, -s.steps, i)
+            for i, s in enumerate(self._slots)
+            if s is not None and s.active and s.req.priority < head.priority
+        ]
+        if not candidates:
+            return None
+        waited_ms = (time.monotonic() - head.t_enqueue) * 1000.0
+        # Unknown estimate projects to +inf: when we cannot show the head
+        # makes its target by waiting, strict priority wins.
+        projected_ms = waited_ms + (
+            est_ms if est_ms is not None else float("inf")
+        )
+        if projected_ms < self.ttft_slo_ms:
+            return None
+        _, _, idx = min(candidates)
+        victim = self._slots[idx]
+        try:
+            fault_point(
+                "scheduler.preempt", slot=idx, steps=victim.steps,
+                victim_priority=victim.req.priority,
+                admit_priority=head.priority,
+            )
+        except Exception:  # noqa: BLE001 — degraded mode: no steal
+            self._bump(preempt_faults=1)
+            get_telemetry().count("serving.preempt_faults")
+            return None
+        if self.paged and self._radix is not None:
+            self._adopt(victim)  # no-op when prefill already adopted them
+        victim.req.meta["preempted"] = (
+            victim.req.meta.get("preempted", 0) + 1
+        )
+        with self._cond:
+            self._queue.requeue(victim.req)
+        self._free([idx])
+        self._bump(preemptions=1)
+        get_telemetry().count("serving.preemptions")
+        return idx
+
+    def _tpot_throttled(self, head: ServeRequest) -> bool:
+        """Defer admitting ``head`` this tick while the per-token EWMA is
+        over the TPOT target — shrinking the multiprogramming level
+        recovers the resident streams instead of letting every one miss.
+        An idle scheduler always admits (no deadlock), and an admit that
+        outranks every resident (the preemption class) still lands."""
+        if self.tpot_slo_ms <= 0.0:
+            return False
+        with self._stats_lock:
+            ewma_ms = self._tpot_ewma_s * 1000.0
+        if ewma_ms <= self.tpot_slo_ms:
+            return False
+        if self._occupied() == 0:
+            return False
+        max_resident = max(
+            (s.req.priority for s in self._slots if s is not None),
+            default=-1,
+        )
+        if head.priority > max_resident:
+            return False
+        self._bump(tpot_throttle_ticks=1)
+        return True
 
     def _map_pages(self, idx: int, slot: _Slot) -> bool:
         """Build the slot's page-table row, sharing what the radix tree
@@ -477,6 +746,31 @@ class ContinuousScheduler:
                 with self._stats_lock:
                     self._prefix["evictions"] += evicted
         fresh = pool.alloc(needed)
+        if fresh is None and (shared or cow_src is not None):
+            # The match itself is starving the pool: its pinned shared/CoW
+            # pages are exactly what eviction would have to free, while
+            # the row still needs ``pages_per_slot - bp`` fresh pages — on
+            # a pool sized to one slot that demand can never be met, and
+            # the admit would defer forever.  Drop the match and retry as
+            # a full no-sharing prefill: identical bytes, just no savings.
+            for phys in shared:
+                pool.unpin(phys)
+            if cow_src is not None:
+                pool.unpin(cow_src)
+            shared, cow_src, kv_shared = [], None, 0
+            bp = 0
+            needed = plan.pages_per_slot
+            if pool.free_count < needed and self._radix is not None:
+                evicted = self._radix.evict(
+                    pool, needed - pool.free_count
+                )
+                if evicted:
+                    with self._stats_lock:
+                        self._prefix["evictions"] += evicted
+            fresh = pool.alloc(needed)
+            if fresh is not None:
+                with self._stats_lock:
+                    self._prefix["fallbacks"] += 1
         if fresh is None:
             for phys in shared:
                 pool.unpin(phys)
@@ -608,6 +902,14 @@ class ContinuousScheduler:
                 slot.t_first = time.monotonic()
                 ttft = slot.t_first - slot.req.t_enqueue
                 self._ttft.observe(ttft)
+                with self._stats_lock:
+                    self._ttft_ewma_s = (
+                        ttft if self._ttft_ewma_s == 0.0
+                        else 0.8 * self._ttft_ewma_s + 0.2 * ttft
+                    )
+                    if (self.ttft_slo_ms > 0.0
+                            and ttft * 1000.0 > self.ttft_slo_ms):
+                        self._stats["ttft_slo_misses"] += 1
                 tel.observe("serving.ttft_seconds", ttft,
                             buckets=_LATENCY_BUCKETS)
                 slot.carry = int(first)
@@ -724,6 +1026,14 @@ class ContinuousScheduler:
         if slot.t_first is not None and len(toks) > 1:
             tpot = (now - slot.t_first) / (len(toks) - 1)
             self._tpot.observe(tpot)
+            with self._stats_lock:
+                self._tpot_ewma_s = (
+                    tpot if self._tpot_ewma_s == 0.0
+                    else 0.8 * self._tpot_ewma_s + 0.2 * tpot
+                )
+                if (self.tpot_slo_ms > 0.0
+                        and tpot * 1000.0 > self.tpot_slo_ms):
+                    self._stats["tpot_slo_misses"] += 1
             tel.observe("serving.tpot_seconds", tpot,
                         buckets=_TOKEN_BUCKETS)
         slot.req.succeed(
@@ -732,6 +1042,10 @@ class ContinuousScheduler:
             tokens=len(toks),
         )
         self._bump(completed=1)
+        with self._stats_lock:
+            self._tenant_ledger(slot.req.tenant)["completed"] += 1
+            if slot.req.meta.get("preempted"):
+                self._stats["resumed"] += 1
         tel.count("serving.decode_completed")
         tel.observe("serving.request_seconds", now - slot.req.t_enqueue,
                     buckets=_LATENCY_BUCKETS)
@@ -842,6 +1156,8 @@ class ContinuousScheduler:
             warmup=self._warmup_record,
             kv_backend="paged" if self.paged else "slots",
         )
+        out["ttft_ewma_ms"] = round(self._ttft_ewma_s * 1000.0, 3)
+        out["tpot_ewma_ms"] = round(self._tpot_ewma_s * 1000.0, 3)
         if self.paged:
             plan = self.plan
             with self._stats_lock:
@@ -876,3 +1192,43 @@ class ContinuousScheduler:
                 prefix_cache=prefix,
             )
         return out
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """The manifest's ``serving.slo.decode`` contribution: targets,
+        preemption/throttle counters, shed taxonomy, and the per-tenant
+        ledger.  Empty when the SLO layer was neither configured nor
+        exercised (only-when-used, like the batcher's)."""
+        with self._stats_lock:
+            tenants = {t: dict(v) for t, v in self._tenants.items()}
+            sheds = {
+                key: self._stats[key]
+                for key in ("shed_queue_full", "shed_slo_unattainable",
+                            "shed_tenant_budget", "shed_evicted")
+            }
+            counters = {
+                key: self._stats[key]
+                for key in ("preemptions", "preempt_faults", "resumed",
+                            "tpot_throttle_ticks", "ttft_slo_misses",
+                            "tpot_slo_misses")
+            }
+        configured = (
+            self.ttft_slo_ms > 0.0 or self.tpot_slo_ms > 0.0
+            or self.tenant_budget > 0.0
+        )
+        exercised = (
+            any(sheds.values()) or any(counters.values())
+            or any(t != DEFAULT_TENANT for t in tenants)
+        )
+        if not configured and not exercised:
+            return {}
+        return {
+            "ttft_slo_ms": self.ttft_slo_ms,
+            "tpot_slo_ms": self.tpot_slo_ms,
+            "tenant_budget_req_s": self.tenant_budget,
+            "default_priority": self.default_priority,
+            "ttft_ewma_ms": round(self._ttft_ewma_s * 1000.0, 3),
+            "tpot_ewma_ms": round(self._tpot_ewma_s * 1000.0, 3),
+            **counters,
+            "sheds": sheds,
+            "tenants": tenants,
+        }

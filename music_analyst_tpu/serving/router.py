@@ -15,13 +15,23 @@ by :func:`spawn_replicas` — behind this router:
   and re-dispatched to the survivors (``resilience/failover.py``
   classification + the shared :class:`RetryPolicy` at the new
   ``router.dispatch`` fault site), and the transition is recorded for
-  the run manifest's ``serving.router`` section;
+  the run manifest's ``serving.router`` section.  A replica whose
+  *process* died is respawned under supervision (capped exponential
+  backoff, transition kind ``respawned``) — the fleet heals itself
+  instead of shrinking monotonically;
+* **per-tenant overload isolation** — the router's admission queue is
+  the same :class:`~music_analyst_tpu.serving.slo.FairQueue` the batcher
+  and decode scheduler use (strict priority classes, per-tenant WFQ),
+  with per-tenant token buckets and deadline-aware ``slo_unattainable``
+  sheds: one greedy tenant sheds at *its own* budget/queue share while
+  the rest of the fleet's capacity keeps flowing;
 * **zero loss** — every admitted request either settles with a replica's
   answer (possibly after re-dispatch) or fails with a structured error
-  (``queue_full`` with a ``retry_after_ms`` hint, ``replica_lost`` when
-  no healthy replica remains); nothing is dropped silently.  Sentiment
-  and wordcount ops are pure functions of their text, so re-dispatching
-  a request whose first answer died with its worker is idempotent;
+  (``queue_full``/``slo_unattainable``, each with a ``retry_after_ms``
+  hint; ``replica_lost`` when no healthy replica remains); nothing is
+  dropped silently.  Sentiment and wordcount ops are pure functions of
+  their text, so re-dispatching a request whose first answer died with
+  its worker is idempotent;
 * **graceful fleet drain** — SIGTERM (installed by :func:`run_router`)
   stops admission, settles everything in flight, then SIGTERMs each
   worker so *their* graceful-drain contract runs, escalating to SIGKILL
@@ -43,7 +53,6 @@ import subprocess
 import sys
 import threading
 import time
-from collections import deque
 from typing import Any, Dict, List, Optional
 
 from music_analyst_tpu.observability import watchdog
@@ -52,11 +61,16 @@ from music_analyst_tpu.resilience.faults import fault_point
 from music_analyst_tpu.resilience.policy import RetryPolicy, classify_retryable
 from music_analyst_tpu.serving.batcher import (
     _RETRY_AFTER_CAP_MS,
+    DEFAULT_TENANT,
     ServeRequest,
     resolve_max_queue,
+    resolve_priority,
     resolve_replicas,
+    resolve_tenant_budget,
     resolve_tp,
+    resolve_ttft_slo_ms,
 )
+from music_analyst_tpu.serving.slo import FairQueue, TokenBucket
 from music_analyst_tpu.telemetry import get_telemetry
 
 # Ops the router will forward; anything else is a bad_request at the edge
@@ -92,13 +106,19 @@ class ReplicaHandle:
     """
 
     def __init__(self, name: str, socket_path: str,
-                 proc: Optional[subprocess.Popen] = None) -> None:
+                 proc: Optional[subprocess.Popen] = None,
+                 cmd: Optional[List[str]] = None) -> None:
         self.name = name
         self.socket_path = socket_path
         self.proc = proc
+        # The argv that started ``proc`` — what supervised respawn
+        # relaunches.  None (externally-managed worker) disables respawn
+        # for this handle.
+        self.cmd = list(cmd) if cmd is not None else None
         self.health = "starting"
         self.dispatched = 0
         self.requeues = 0
+        self.respawns = 0
         self.last_stats: Optional[Dict[str, Any]] = None
         self._sock = None
         self._wfile = None
@@ -227,7 +247,7 @@ class ReplicaHandle:
                 req.complete(payload)
                 on_reply = self._on_reply
                 if on_reply is not None:
-                    on_reply(bool(payload.get("ok")))
+                    on_reply(req, bool(payload.get("ok")))
         except (OSError, ValueError):
             pass
         finally:
@@ -262,6 +282,7 @@ class ReplicaHandle:
             "alive": self.alive(),
             "dispatched": self.dispatched,
             "requeues": self.requeues,
+            "respawns": self.respawns,
             "in_flight": self.in_flight(),
             "last_stats": self.last_stats,
         }
@@ -276,12 +297,17 @@ class _RouterDecode:
         self._router = router
 
     def submit(self, rid: Any, text: str,
-               max_new_tokens: Optional[int] = None) -> ServeRequest:
+               max_new_tokens: Optional[int] = None,
+               tenant: Optional[str] = None,
+               priority: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> ServeRequest:
         meta = (
             {"max_new_tokens": int(max_new_tokens)}
             if max_new_tokens is not None else {}
         )
-        return self._router.submit(rid, "generate", text, meta=meta)
+        return self._router.submit(rid, "generate", text, meta=meta,
+                                   tenant=tenant, priority=priority,
+                                   deadline_ms=deadline_ms)
 
     def drain(self, timeout: Optional[float] = None) -> None:
         pass  # the router's own drain covers the fleet
@@ -299,6 +325,12 @@ class ReplicaRouter:
         max_queue: Optional[int] = None,
         poll_interval_s: float = 0.25,
         redispatch_limit: int = 3,
+        respawn: bool = True,
+        respawn_backoff_s: float = 0.5,
+        respawn_cap_s: float = 30.0,
+        ttft_slo_ms: Optional[float] = None,
+        tenant_budget: Optional[float] = None,
+        priority: Optional[int] = None,
     ) -> None:
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -306,9 +338,16 @@ class ReplicaRouter:
         self.max_queue = resolve_max_queue(max_queue)
         self.poll_interval_s = float(poll_interval_s)
         self.redispatch_limit = int(redispatch_limit)
+        self.respawn = bool(respawn)
+        self.respawn_backoff_s = float(respawn_backoff_s)
+        self.respawn_cap_s = float(respawn_cap_s)
+        self.ttft_slo_ms = resolve_ttft_slo_ms(ttft_slo_ms)
+        self.tenant_budget = resolve_tenant_budget(tenant_budget)
+        self.default_priority = resolve_priority(priority)
         self._retry = RetryPolicy(base_s=0.05, cap_s=1.0)
         self._cond = threading.Condition()
-        self._queue: deque = deque()
+        self._queue = FairQueue()
+        self._buckets: Dict[str, TokenBucket] = {}
         self._draining = False
         self._threads: List[threading.Thread] = []
         self._wire_ids = 0
@@ -317,11 +356,15 @@ class ReplicaRouter:
             "admitted": 0, "shed": 0, "completed": 0, "failed": 0,
             "bad_request": 0, "dispatched": 0, "requeued": 0,
             "queue_depth_max": 0, "retry_after_ms_last": None,
+            "respawns": 0, "respawn_failures": 0,
+            "shed_queue_full": 0, "shed_slo_unattainable": 0,
+            "shed_tenant_budget": 0, "shed_evicted": 0,
         }
+        self._tenants: Dict[str, Dict[str, int]] = {}
         self._transitions: List[Dict[str, Any]] = []
         self._started_mono = time.monotonic()
-        self._settle_rate = 0.0
-        self._settle_mark = time.monotonic()
+        # Per-replica respawn backoff: name -> [not_before_t, backoff_s].
+        self._respawn_state: Dict[str, List[float]] = {}
         for handle in self.replicas:
             handle._on_lost = self._replica_lost
             handle._on_reply = self._reply_settled
@@ -375,11 +418,26 @@ class ReplicaRouter:
     # ----------------------------------------------------------- admission
 
     def submit(self, rid: Any, op: str, text: str,
-               meta: Optional[Dict[str, Any]] = None) -> ServeRequest:
+               meta: Optional[Dict[str, Any]] = None,
+               tenant: Optional[str] = None,
+               priority: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> ServeRequest:
         """Admit (or shed) one request; mirrors ``DynamicBatcher.submit``
-        so a ``SentimentServer`` can sit directly in front."""
+        so a ``SentimentServer`` can sit directly in front — including
+        the SLO shed ladder (per-tenant token bucket, deadline-aware
+        ``slo_unattainable``, priority-aware eviction), so one greedy
+        tenant sheds at its own budget instead of the whole fleet's."""
         tel = get_telemetry()
-        req = ServeRequest(rid, op, text, meta=meta)
+        if deadline_ms is None and self.ttft_slo_ms > 0.0:
+            deadline_ms = self.ttft_slo_ms
+        req = ServeRequest(
+            rid, op, text, meta=meta,
+            tenant=tenant or DEFAULT_TENANT,
+            priority=(
+                self.default_priority if priority is None else int(priority)
+            ),
+            deadline_ms=deadline_ms,
+        )
         if op not in _FORWARD_OPS:
             req.fail("bad_request",
                      f"unknown op {op!r}; have: {sorted(_FORWARD_OPS)}")
@@ -388,33 +446,108 @@ class ReplicaRouter:
         with self._cond:
             if self._draining:
                 req.fail("draining", "router is draining; not admitting")
-                self._bump(shed=1)
-                tel.count("router.shed")
+                self._shed(req, None, None)
                 return req
+            if self.tenant_budget > 0.0:
+                bucket = self._buckets.get(req.tenant)
+                if bucket is None:
+                    bucket = self._buckets[req.tenant] = TokenBucket(
+                        self.tenant_budget
+                    )
+                if not bucket.take():
+                    hint_ms = max(
+                        bucket.retry_after_ms(), self.retry_after_ms(1)
+                    )
+                    req.fail(
+                        "queue_full",
+                        f"tenant {req.tenant!r} over its admission budget "
+                        f"({self.tenant_budget:g} req/s); retry after "
+                        f"{hint_ms:.0f} ms",
+                        retry_after_ms=hint_ms,
+                    )
+                    self._shed(req, "shed_tenant_budget", hint_ms)
+                    return req
+            if req.deadline_ms is not None and req.deadline_ms > 0.0:
+                est_ms = self._drain_estimate_ms(req.priority)
+                if est_ms is not None and est_ms > req.deadline_ms:
+                    hint_ms = self.retry_after_ms(len(self._queue))
+                    req.fail(
+                        "slo_unattainable",
+                        f"drain estimate {est_ms:.0f} ms already exceeds "
+                        f"the {req.deadline_ms:.0f} ms deadline; retry "
+                        f"after {hint_ms:.0f} ms",
+                        retry_after_ms=hint_ms,
+                        estimate_ms=round(est_ms, 3),
+                    )
+                    self._shed(req, "shed_slo_unattainable", hint_ms)
+                    return req
             depth = len(self._queue)
             if depth >= self.max_queue:
+                victim = self._queue.shed_candidate(req.tenant, req.priority)
                 hint_ms = self.retry_after_ms(depth)
-                req.fail(
+                if victim is None:
+                    req.fail(
+                        "queue_full",
+                        f"router queue full ({depth}/{self.max_queue}); "
+                        f"retry after {hint_ms:.0f} ms",
+                        retry_after_ms=hint_ms,
+                    )
+                    self._shed(req, "shed_queue_full", hint_ms)
+                    return req
+                victim.fail(
                     "queue_full",
-                    f"router queue full ({depth}/{self.max_queue}); "
+                    f"evicted for a priority-{req.priority} admit with "
+                    f"the router queue full ({depth}/{self.max_queue}); "
                     f"retry after {hint_ms:.0f} ms",
                     retry_after_ms=hint_ms,
                 )
-                with self._stats_lock:
-                    self._stats["shed"] += 1
-                    self._stats["retry_after_ms_last"] = hint_ms
-                tel.count("router.shed")
-                return req
+                self._shed(victim, "shed_evicted", hint_ms)
             self._queue.append(req)
-            depth += 1
+            depth = len(self._queue)
             self._cond.notify_all()
         with self._stats_lock:
             self._stats["admitted"] += 1
+            self._tenant_ledger(req.tenant)["admitted"] += 1
             if depth > self._stats["queue_depth_max"]:
                 self._stats["queue_depth_max"] = depth
         tel.count("router.admitted")
         tel.gauge("router.queue_depth", depth)
         return req
+
+    def _tenant_ledger(self, tenant: str) -> Dict[str, int]:
+        """Caller holds ``_stats_lock``."""
+        ledger = self._tenants.get(tenant)
+        if ledger is None:
+            ledger = self._tenants[tenant] = {
+                "admitted": 0, "completed": 0, "shed": 0,
+            }
+        return ledger
+
+    def _shed(self, req: ServeRequest, kind_stat: Optional[str],
+              hint_ms: Optional[float]) -> None:
+        with self._stats_lock:
+            self._stats["shed"] += 1
+            if kind_stat in self._stats:
+                self._stats[kind_stat] += 1
+            if hint_ms is not None:
+                self._stats["retry_after_ms_last"] = hint_ms
+            self._tenant_ledger(req.tenant)["shed"] += 1
+        get_telemetry().count("router.shed")
+
+    def _settle_rate(self) -> float:
+        """Fleet-wide settle throughput (requests/s since start)."""
+        with self._stats_lock:
+            settled = self._stats["completed"] + self._stats["failed"]
+        elapsed = max(time.monotonic() - self._started_mono, 1e-6)
+        return settled / elapsed if settled else 0.0
+
+    def _drain_estimate_ms(self, priority: int) -> Optional[float]:
+        """Time until a newcomer at ``priority`` would dispatch (caller
+        holds cond); None before the first settle."""
+        rate = self._settle_rate()
+        if rate <= 0.0:
+            return None
+        return self._queue.depth_ahead(priority) / rate * 1000.0
 
     def retry_after_ms(self, depth: Optional[int] = None) -> float:
         """Backoff hint for a shed client (the batcher's formula over the
@@ -422,7 +555,7 @@ class ReplicaRouter:
         if depth is None:
             with self._cond:
                 depth = len(self._queue)
-        rate = self._settle_rate
+        rate = self._settle_rate()
         hint = depth / rate * 1000.0 if rate > 0.0 else 50.0 * max(depth, 1)
         return round(min(max(hint, 1.0), _RETRY_AFTER_CAP_MS), 3)
 
@@ -457,6 +590,14 @@ class ReplicaRouter:
         budget = req.meta.get("max_new_tokens")
         if budget is not None:
             payload["max_new_tokens"] = budget
+        # Forward the SLO identity so the worker's own scheduler sees the
+        # same tenant/priority the router queued under.  The deadline is
+        # NOT forwarded: the router already spent (and accounted for) the
+        # queue wait; re-arming it downstream would double-count.
+        if req.tenant != DEFAULT_TENANT:
+            payload["tenant"] = req.tenant
+        if req.priority != self.default_priority:
+            payload["priority"] = req.priority
         return payload
 
     def _send_once(self, handle: ReplicaHandle, req: ServeRequest) -> None:
@@ -519,7 +660,7 @@ class ReplicaRouter:
                         return
                     self._cond.wait(0.05)
                 req = self._queue.popleft()
-            if req.done:  # shed/settled while queued
+            if req is None or req.done:  # shed/settled while queued
                 continue
             self._dispatch_one(req)
             watchdog.beat("router.dispatch")
@@ -574,9 +715,9 @@ class ReplicaRouter:
                 self._bump(failed=1)
                 continue
             with self._cond:
-                # Head of the queue: a re-dispatched request has already
-                # waited one full replica lifetime.
-                self._queue.appendleft(req)
+                # Head of its tenant queue: a re-dispatched request has
+                # already waited one full replica lifetime.
+                self._queue.requeue(req)
                 self._cond.notify_all()
             requeued += 1
         handle.requeues += requeued
@@ -629,38 +770,115 @@ class ReplicaRouter:
                         handle, "dead", "tunnel_dead",
                         "worker process exited",
                     )
+                elif handle.health == "dead":
+                    self._maybe_respawn(handle)
             time.sleep(self.poll_interval_s)
+
+    def _maybe_respawn(self, handle: ReplicaHandle) -> None:
+        """Supervised restart of a dead worker, gated by a capped
+        exponential backoff so a crash-looping worker cannot monopolize
+        the poll thread.  Success re-enters the handle into rotation with
+        a ``respawned`` health transition; failure doubles the backoff
+        and counts ``respawn_failures``.  Externally-managed workers
+        (no spawn cmd) and a draining router never respawn."""
+        if not self.respawn or handle.cmd is None or self._draining:
+            return
+        state = self._respawn_state.setdefault(
+            handle.name, [0.0, self.respawn_backoff_s]
+        )
+        if time.monotonic() < state[0]:
+            return
+        handle.close()
+        try:
+            os.unlink(handle.socket_path)
+        except OSError:
+            pass
+        try:
+            handle.proc = subprocess.Popen(
+                handle.cmd,
+                stdin=subprocess.DEVNULL,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+                start_new_session=True,
+            )
+            handle.connect()
+        except Exception as exc:  # noqa: BLE001 — backoff and retry
+            handle.terminate(grace_s=1.0)  # reap a half-started process
+            state[0] = time.monotonic() + state[1]
+            state[1] = min(state[1] * 2.0, self.respawn_cap_s)
+            self._bump(respawn_failures=1)
+            get_telemetry().count("router.respawn_failures")
+            get_telemetry().event(
+                "router_respawn_failed", replica=handle.name,
+                error=str(exc)[:200],
+                next_backoff_s=round(state[1], 3),
+            )
+            return
+        state[0] = 0.0
+        state[1] = self.respawn_backoff_s
+        handle.respawns += 1
+        self._bump(respawns=1)
+        get_telemetry().count("router.respawns")
+        self._record_transition(
+            handle, "healthy", "respawned",
+            f"respawned as pid {handle.proc.pid}",
+        )
 
     # ------------------------------------------------------------ readouts
 
-    def _reply_settled(self, ok: bool) -> None:
+    def _reply_settled(self, req: ServeRequest, ok: bool) -> None:
         """Per-reply bookkeeping (called from each handle's reader
-        thread); feeds the settle rate behind ``retry_after_ms``."""
+        thread); feeds the settle rate behind ``retry_after_ms`` and the
+        per-tenant ledger."""
         with self._stats_lock:
             self._stats["completed" if ok else "failed"] += 1
+            if ok:
+                self._tenant_ledger(req.tenant)["completed"] += 1
 
     def stats(self) -> Dict[str, Any]:
         """JSON-able snapshot for the manifest's ``serving.router``
         section: per-replica dispatch counts, health transitions,
-        requeues, and the admission counters."""
-        now = time.monotonic()
-        settled = 0
+        requeues/respawns, and the admission counters."""
         with self._stats_lock:
             out: Dict[str, Any] = dict(self._stats)
             transitions = list(self._transitions)
-            settled = out["completed"] + out["failed"]
-        elapsed = max(now - self._started_mono, 1e-6)
-        self._settle_rate = settled / elapsed
         out.update(
             replica_count=len(self.replicas),
             healthy_count=sum(
                 1 for h in self.replicas if h.health == "healthy"
             ),
             max_queue=self.max_queue,
+            settle_rate_req_s=round(self._settle_rate(), 3),
             health_transitions=transitions,
             replicas={h.name: h.snapshot() for h in self.replicas},
         )
         return out
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """The manifest's ``serving.slo`` contribution when the router is
+        the admission edge; empty when neither configured nor
+        exercised."""
+        with self._stats_lock:
+            tenants = {t: dict(v) for t, v in self._tenants.items()}
+            sheds = {
+                key: self._stats[key]
+                for key in ("shed_queue_full", "shed_slo_unattainable",
+                            "shed_tenant_budget", "shed_evicted")
+            }
+        configured = self.ttft_slo_ms > 0.0 or self.tenant_budget > 0.0
+        exercised = (
+            any(sheds.values())
+            or any(t != DEFAULT_TENANT for t in tenants)
+        )
+        if not configured and not exercised:
+            return {}
+        return {
+            "ttft_slo_ms": self.ttft_slo_ms,
+            "tenant_budget_req_s": self.tenant_budget,
+            "default_priority": self.default_priority,
+            "sheds": sheds,
+            "tenants": tenants,
+        }
 
 
 # ----------------------------------------------------------------- CLI glue
@@ -681,6 +899,10 @@ def _replica_cmd(
     page_size: Optional[int],
     kv_pages: Optional[int],
     warmup: bool,
+    ttft_slo_ms: Optional[float] = None,
+    tpot_slo_ms: Optional[float] = None,
+    tenant_budget: Optional[float] = None,
+    priority: Optional[int] = None,
 ) -> List[str]:
     cmd = [
         sys.executable, "-m", "music_analyst_tpu", "serve",
@@ -701,6 +923,10 @@ def _replica_cmd(
         ("--prefill-chunk", prefill_chunk),
         ("--page-size", page_size),
         ("--kv-pages", kv_pages),
+        ("--ttft-slo-ms", ttft_slo_ms),
+        ("--tpot-slo-ms", tpot_slo_ms),
+        ("--tenant-budget", tenant_budget),
+        ("--priority", priority),
     ):
         if value is not None:
             cmd += [flag, str(value)]
@@ -727,12 +953,18 @@ def spawn_replicas(
     kv_pages: Optional[int] = None,
     warmup: bool = True,
     connect: bool = True,
+    ttft_slo_ms: Optional[float] = None,
+    tpot_slo_ms: Optional[float] = None,
+    tenant_budget: Optional[float] = None,
+    priority: Optional[int] = None,
 ) -> List[ReplicaHandle]:
     """Start ``n`` worker server processes and (optionally) connect.
 
     Workers inherit the parent environment (so ``MUSICAAL_*`` and the
     CPU-emulation ``XLA_FLAGS`` flow through) and run with telemetry off
-    — fleet-level stats live in the router's manifest section.
+    — fleet-level stats live in the router's manifest section.  Each
+    handle keeps its spawn cmd, so the router's supervised respawn can
+    relaunch a dead worker in place.
     """
     handles: List[ReplicaHandle] = []
     try:
@@ -742,6 +974,8 @@ def spawn_replicas(
                 socket_path, model, mock, weight_quant, tp, max_batch,
                 max_wait_ms, max_queue, slots, prefill_chunk,
                 max_new_tokens, page_size, kv_pages, warmup,
+                ttft_slo_ms=ttft_slo_ms, tpot_slo_ms=tpot_slo_ms,
+                tenant_budget=tenant_budget, priority=priority,
             )
             proc = subprocess.Popen(
                 cmd,
@@ -751,7 +985,8 @@ def spawn_replicas(
                 start_new_session=True,
             )
             handles.append(
-                ReplicaHandle(f"replica-{i}", socket_path, proc=proc)
+                ReplicaHandle(f"replica-{i}", socket_path, proc=proc,
+                              cmd=cmd)
             )
         if connect:
             for handle in handles:
@@ -781,6 +1016,10 @@ def run_router(
     max_new_tokens: int = 16,
     page_size: Optional[int] = None,
     kv_pages: Optional[int] = None,
+    ttft_slo_ms: Optional[float] = None,
+    tpot_slo_ms: Optional[float] = None,
+    tenant_budget: Optional[float] = None,
+    priority: Optional[int] = None,
 ) -> int:
     """``serve --replicas N`` (N > 1): spawn the fleet, route until
     drained.  The front end is a stock ``SentimentServer`` with the
@@ -803,8 +1042,13 @@ def run_router(
                 prefill_chunk=prefill_chunk,
                 max_new_tokens=max_new_tokens, page_size=page_size,
                 kv_pages=kv_pages, warmup=warmup,
+                ttft_slo_ms=ttft_slo_ms, tpot_slo_ms=tpot_slo_ms,
+                tenant_budget=tenant_budget, priority=priority,
             )
-            router = ReplicaRouter(handles, max_queue=max_queue).start()
+            router = ReplicaRouter(
+                handles, max_queue=max_queue, ttft_slo_ms=ttft_slo_ms,
+                tenant_budget=tenant_budget, priority=priority,
+            ).start()
             server = SentimentServer(
                 router, mode="stdio" if stdio else "unix",
                 decode=_RouterDecode(router), router=router,

@@ -16,12 +16,19 @@ socket or the process's own stdio; nothing here can reach a network):
   runtime (``serving/decode_loop.py``) instead of the dynamic batcher:
   its reply is ``{"text":…, "label":…, "tokens":…}`` and it can
   overlap with sentiment/wordcount batches on the same connection.
+  Every submit op also accepts the SLO/isolation fields
+  (``serving/slo.py``): ``tenant`` (string fair-queue identity),
+  ``priority`` (integer class, higher first), ``deadline_ms``
+  (arrival-relative TTFT deadline; defaults to the configured
+  ``--ttft-slo-ms`` when one is set).
 * response: one JSON line per request, **in request arrival order per
   connection**: ``{"id":…, "ok": true, "op":…, …payload}`` or
   ``{"id":…, "ok": false, "error": {"kind":…, "detail":…}}``.
   Structured error kinds: ``queue_full`` (admission shed — retry with
-  backoff), ``bad_request``, ``request_failed`` (that request's model
-  row raised; the server lives on), ``draining``.
+  backoff), ``slo_unattainable`` (the drain estimate already blows the
+  request's deadline; both sheds carry ``retry_after_ms``),
+  ``bad_request``, ``request_failed`` (that request's model row raised;
+  the server lives on), ``draining``.
 
 **Graceful drain**: SIGTERM/SIGINT (or the ``shutdown`` op, or stdin
 EOF in ``--stdio`` mode) stops admission, finishes every in-flight and
@@ -215,6 +222,28 @@ class SentimentServer:
             req = ServeRequest(rid, op, "")
             req.fail("bad_request", "missing/non-string 'text' field")
             return req
+        tenant = payload.get("tenant")
+        if tenant is not None and not isinstance(tenant, str):
+            req = ServeRequest(rid, op, text)
+            req.fail("bad_request", "'tenant' must be a string")
+            return req
+        priority = payload.get("priority")
+        if priority is not None and (
+            isinstance(priority, bool) or not isinstance(priority, int)
+        ):
+            req = ServeRequest(rid, op, text)
+            req.fail("bad_request", "'priority' must be an integer")
+            return req
+        deadline_ms = payload.get("deadline_ms")
+        if deadline_ms is not None and (
+            isinstance(deadline_ms, bool)
+            or not isinstance(deadline_ms, (int, float))
+        ):
+            req = ServeRequest(rid, op, text)
+            req.fail("bad_request", "'deadline_ms' must be a number")
+            return req
+        slo = {"tenant": tenant, "priority": priority,
+               "deadline_ms": deadline_ms}
         if op == "generate":
             if self.decode is None:
                 req = ServeRequest(rid, op, text)
@@ -230,8 +259,9 @@ class SentimentServer:
                 req.fail("bad_request",
                          "'max_new_tokens' must be an integer")
                 return req
-            return self.decode.submit(rid, text, max_new_tokens=budget)
-        return self.batcher.submit(rid, op, text)
+            return self.decode.submit(rid, text, max_new_tokens=budget,
+                                      **slo)
+        return self.batcher.submit(rid, op, text, **slo)
 
     # ---------------------------------------------------------- stream I/O
 
@@ -369,6 +399,20 @@ class SentimentServer:
             out["residency"] = self.residency.snapshot()
         if self.router is not None:
             out["router"] = self.router.stats()
+        # SLO layer (serving/slo.py) — only-when-used, like the
+        # corpus-cache manifest section: empty snapshots stay out.
+        slo: Dict[str, Any] = {}
+        snap = getattr(self.batcher, "slo_snapshot", None)
+        if callable(snap):
+            slo.update(snap() or {})
+        if self.decode is not None:
+            snap = getattr(self.decode, "slo_snapshot", None)
+            if callable(snap):
+                decode_slo = snap() or {}
+                if decode_slo:
+                    slo["decode"] = decode_slo
+        if slo:
+            out["slo"] = slo
         return out
 
 
@@ -412,6 +456,10 @@ def run_server(
     page_size: Optional[int] = None,
     kv_pages: Optional[int] = None,
     tp: Optional[int] = None,
+    ttft_slo_ms: Optional[float] = None,
+    tpot_slo_ms: Optional[float] = None,
+    tenant_budget: Optional[float] = None,
+    priority: Optional[int] = None,
 ) -> int:
     """The ``serve`` subcommand: load, warm, then serve until drained.
 
@@ -441,6 +489,9 @@ def run_server(
             max_wait_ms=max_wait_ms,
             max_queue=max_queue,
             failover=lambda exc: residency.reload() is not None,
+            ttft_slo_ms=ttft_slo_ms,
+            tenant_budget=tenant_budget,
+            priority=priority,
         ).start()
         # Continuous decode runtime for the ``generate`` op — only when
         # the backend exposes a slot runtime (capability probe) and slots
@@ -459,6 +510,10 @@ def run_server(
                 max_queue=max_queue,
                 page_size=page_size,
                 kv_pages=kv_pages,
+                ttft_slo_ms=ttft_slo_ms,
+                tpot_slo_ms=tpot_slo_ms,
+                tenant_budget=tenant_budget,
+                priority=priority,
             )
             if warmup:
                 record = residency.warmup_decode(decode)

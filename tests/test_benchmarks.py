@@ -21,7 +21,7 @@ def test_every_advertised_module_registers(monkeypatch):
     for expected in (
         "roofline", "flash_sweep", "generation", "coldstart", "ingest",
         "scaling", "joint", "llama_zeroshot", "sentiment_int8", "bucketing",
-        "overlap", "streaming", "serving", "router",
+        "overlap", "streaming", "serving", "router", "slo",
     ):
         assert expected in names
 
@@ -62,3 +62,22 @@ def test_subprocess_suite_runs_smoke(name, monkeypatch):
         assert all(r["balanced"] for r in table["rows"])
     else:
         assert len(table["runs"]) >= 1
+
+
+def test_slo_suite_meets_acceptance_bar(monkeypatch):
+    """The overload suite's headline booleans ARE the ISSUE-13 bar:
+    gold TTFT inside its SLO at 4× load, every rejection structured,
+    nothing silently dropped, preempt-resume byte-identical with zero
+    retraces."""
+    monkeypatch.setenv("MUSICAAL_BENCH_SMOKE", "1")
+    import benchmarks
+
+    benchmarks._load_all()
+    table = benchmarks._SUITES["slo"]()
+    assert table["suite"] == "slo" and table["smoke"] is True
+    json.dumps(table)
+    assert table["gold_within_slo"] is True
+    assert table["all_sheds_structured"] is True
+    assert table["zero_silent_drops"] is True
+    assert table["preempt_bytes_identical"] is True
+    assert table["zero_retraces"] is True

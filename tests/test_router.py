@@ -172,7 +172,11 @@ def test_kill_replica_under_load_loses_nothing(tmp_path):
     handles = spawn_replicas(
         2, str(tmp_path), model="mock", mock=True, warmup=False
     )
-    router = ReplicaRouter(handles, poll_interval_s=0.05).start()
+    # respawn=False: this test pins the UNSUPERVISED kill semantics
+    # (the corpse stays dead); auto-respawn has its own coverage.
+    router = ReplicaRouter(
+        handles, poll_interval_s=0.05, respawn=False
+    ).start()
     try:
         first = [
             router.submit(i, "sentiment", TEXTS[i % len(TEXTS)])

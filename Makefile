@@ -50,6 +50,30 @@ print("router self-check ok:", ok, "answered,", shed, "shed,",
 endef
 export ROUTER_SELFCHECK
 
+# Crash-recovery self-check body (exported below): the mid-decode drill
+# from benchmarks/crash.py — SIGKILL a journaled generate server while a
+# request is in flight on device, restart it on the SAME journal dir,
+# re-send everything a reconnecting client would retry, and assert 100%
+# accounting, zero duplicate computes (the journal's dedup index answers
+# already-sent replies byte-identically), and the unclean_shutdown stamp
+# in the restart's run manifest.
+define CRASH_SELFCHECK
+import sys
+from benchmarks.crash import _GEN_ARGS, _gen_trace, run_drill
+row = run_drill("mid_decode", "decode.step:crash@3", sys.argv[1],
+                model_args=_GEN_ARGS, trace=_gen_trace(3, seed=17))
+assert row["killed_by_sigkill"], row
+assert row["recovered_exit_ok"], row
+assert row["all_accounted"] and row["loadgen_silent_drops"] == 0, row
+assert row["duplicates_deduped"], row
+assert row["unclean_stamped"], row
+print("crash-recovery self-check ok:",
+      row["journal"]["replayed"], "replayed,",
+      row["journal"]["deduped"], "deduped,",
+      "%.1fs" % row["wall_s"])
+endef
+export CRASH_SELFCHECK
+
 # Fast observability gate: profiling + telemetry + pipeline +
 # observability + corpus-cache/streaming unit tests, then one
 # smoke-shaped bench.py run through the full parent/child/--baseline
@@ -66,7 +90,8 @@ smoke:
 		tests/test_observability.py tests/test_corpus_cache.py \
 		tests/test_wq_store.py tests/test_serving.py \
 		tests/test_resilience.py tests/test_continuous.py \
-		tests/test_kv_pages.py tests/test_router.py -q
+		tests/test_kv_pages.py tests/test_router.py \
+		tests/test_journal.py -q
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= MUSICAAL_BENCH_SMOKE=1 \
 		$(PY) bench.py --baseline --attempts 1 --deadline 240 \
 		| $(PY) -c "import json,sys; \
@@ -202,6 +227,14 @@ print('smoke ok:', payload['metric'], payload['value'])"
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 		$(PY) -c "$$ROUTER_SELFCHECK" "$$routertmp" || \
 		{ echo "router self-check failed"; exit 1; }
+	# crash-recovery self-check (body in CRASH_SELFCHECK above): SIGKILL
+	# the journaled generate server mid-decode, restart on the same
+	# journal dir — every request answered, nothing computed twice,
+	# unclean shutdown stamped.
+	crashtmp=$$(mktemp -d) && trap 'rm -rf "$$crashtmp"' EXIT && \
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+		$(PY) -c "$$CRASH_SELFCHECK" "$$crashtmp" || \
+		{ echo "crash-recovery self-check failed"; exit 1; }
 	# chaos self-check: analyze with a transient fault injected at the
 	# ingest seam — the run must recover (retry counter in the manifest)
 	# and write a word_counts.csv byte-identical to the clean run (the

@@ -41,6 +41,7 @@ _SUITE_MODULES = (
     "benchmarks.router",
     "benchmarks.chaos",
     "benchmarks.slo",
+    "benchmarks.crash",
 )
 
 

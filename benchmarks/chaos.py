@@ -291,6 +291,64 @@ def _prefix_lookup_scenario(n_requests: int) -> dict:
     }
 
 
+def _journal_scenario() -> dict:
+    """Faulted appends + a torn segment tail (site ``journal.append``):
+    the server-side append failure is absorbed (the request still
+    answers, just un-journaled), and on restart the CRC scan counts the
+    corruption and degrades the lost reply to a replayed recompute —
+    never to a wrong or duplicate answer."""
+    from music_analyst_tpu.resilience import configure_faults, fault_stats
+    from music_analyst_tpu.serving.journal import RequestJournal
+
+    with tempfile.TemporaryDirectory(prefix="chaos_journal_") as base:
+        directory = os.path.join(base, "wal")
+        journal = RequestJournal(directory, sync_every=1)
+        journal.recover()
+        configure_faults("journal.append:error@3")
+        try:
+            journal.record_admitted("a", "sentiment", "love and rain")
+            journal.record_admitted("b", "sentiment", "cold gray sky")
+            # Append 3 — reply "a" — trips: the reply stays in memory and
+            # on the wire, but never reaches disk.
+            journal.record_replied("a", {"ok": True, "label": "Positive"})
+            journal.record_replied("b", {"ok": True, "label": "Negative"})
+            trips = fault_stats()["journal.append"]["trips"]
+        finally:
+            configure_faults(None)
+        append_errors = journal.stats()["append_errors"]
+        # SIGKILL stand-in: abandon the handle (no close(), no compaction,
+        # no clean marker) and tear the active segment's tail.
+        segments = sorted(
+            name for name in os.listdir(directory)
+            if name.startswith("journal-")
+        )
+        with open(os.path.join(directory, segments[-1]), "ab") as fh:
+            fh.write(b"\xff" * 12)
+        reopened = RequestJournal(directory)
+        unanswered = reopened.recover()
+        stats = reopened.stats()
+        replayed_ids = sorted(str(r.get("id")) for r in unanswered)
+        lost_recomputes = reopened.lookup_reply("a") is None
+        survivor = (reopened.lookup_reply("b") or {}).get("label")
+    return {
+        "scenario": "journal_append_fault",
+        "spec": "journal.append:error@3",
+        "trips": trips,
+        "append_errors": append_errors,
+        "corrupt_truncated": stats["corrupt_truncated"],
+        "unclean_start": stats["unclean_start"],
+        "replayed_ids": replayed_ids,
+        "degraded_to_recompute": (
+            append_errors >= 1
+            and stats["corrupt_truncated"] >= 1
+            and stats["unclean_start"]
+            and replayed_ids == ["a"]  # the lost reply recomputes...
+            and lost_recomputes
+            and survivor == "Negative"  # ...the durable one dedups
+        ),
+    }
+
+
 def _preempt_scenario() -> dict:
     """Injected ``scheduler.preempt`` fault: the steal is abandoned
     BEFORE any slot mutation, so the run degrades to "no preemption this
@@ -466,6 +524,14 @@ def run() -> dict:
             file=sys.stderr,
         )
 
+        journal_wal = _journal_scenario()
+        print(
+            f"[chaos] journal_append: degraded_to_recompute="
+            f"{journal_wal['degraded_to_recompute']} "
+            f"corrupt={journal_wal['corrupt_truncated']}",
+            file=sys.stderr,
+        )
+
     reset_retry_stats()
     return {
         "suite": "chaos",
@@ -480,6 +546,7 @@ def run() -> dict:
         "router": router,
         "prefix_lookup": prefix,
         "preempt_fault": preempt,
+        "journal_append": journal_wal,
         "all_identical": all(
             s["bytes_identical"] for s in scenarios
         ) and prefix["bytes_identical"] and preempt["bytes_identical"],
@@ -490,5 +557,6 @@ def run() -> dict:
         ) and serving["all_answered"] and decode["all_answered"]
         and router["all_answered"] and prefix["all_fell_back"]
         and preempt["preempt_faults"] > 0
-        and preempt["preemptions_faulted"] == 0,
+        and preempt["preemptions_faulted"] == 0
+        and journal_wal["degraded_to_recompute"],
     }

@@ -365,6 +365,13 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                    help="Default priority class for requests that don't "
                         "carry one on the wire (higher serves first; "
                         "default $MUSICAAL_SERVE_PRIORITY or 1)")
+    p.add_argument("--journal-dir", default=None,
+                   help="Durable request journal directory: admitted/"
+                        "replied records are fsync'd there, unanswered "
+                        "requests replay on restart, and re-sent ids "
+                        "return the journaled reply instead of "
+                        "recomputing (default $MUSICAAL_SERVE_JOURNAL; "
+                        "unset = journaling off)")
     p.add_argument("--no-warmup", action="store_true",
                    help="Skip the startup warmup batches (first request "
                         "pays compile cost)")
@@ -641,6 +648,7 @@ def _dispatch(parser: argparse.ArgumentParser,
                 tpot_slo_ms=args.tpot_slo_ms,
                 tenant_budget=args.tenant_budget,
                 priority=args.priority,
+                journal_dir=args.journal_dir,
             )
             if resolve_replicas(args.replicas) > 1:
                 from music_analyst_tpu.serving.router import run_router

@@ -51,6 +51,8 @@ _ERROR_PATTERNS = (
     ("router_stall", ("router stall", "router_stall", "router.dispatch",
                       "replica lost", "replica_lost")),
     ("deadline_expired", ("deadline",)),
+    ("unclean_shutdown", ("unclean shutdown", "unclean_shutdown",
+                          "journal without clean marker")),
     ("harness_killed", ("killed by harness", "sigkill")),
 )
 
@@ -227,6 +229,12 @@ def _dir_record(directory: str, label: str) -> Optional[Dict[str, Any]]:
             rec["degraded"] = True
             rec["degraded_site"] = manifest.get("degraded_site")
             rec["degraded_reason"] = manifest.get("degraded_reason")
+        # A run that started after an unclean predecessor (SIGKILL, cord
+        # pull): the *previous* run's failure, witnessed by this one's
+        # journal scan — reported without failing this run.
+        if manifest.get("unclean_shutdown"):
+            rec["unclean_shutdown"] = True
+            rec["unclean_witness"] = manifest.get("unclean_witness")
     if os.path.exists(jsonl_path):
         found = True
         scan = _scan_jsonl(jsonl_path)

@@ -447,6 +447,18 @@ class PagePool:
         self.slot_refs[phys] = refs
         self._maybe_free(phys)
 
+    def pin_row(self, pages: Sequence[int]) -> None:
+        """Pin every page of one table row — a checkpoint taking its own
+        reference so the row survives the slot's release (and the zeroing
+        failure path, which only touches fully-unreferenced pages)."""
+        for phys in pages:
+            self.pin(phys)
+
+    def unpin_row(self, pages: Sequence[int]) -> None:
+        """Release one reference from every page of a table row."""
+        for phys in pages:
+            self.unpin(phys)
+
     def tree_add(self, phys: int) -> None:
         if self.in_tree[phys]:
             raise ValueError(f"page {phys} already in the radix index")

@@ -5,7 +5,7 @@ prompt to the batch's longest and decodes all rows to the batch's largest
 token budget — a late arrival waits for the whole batch.  This module is
 the device half of the continuous-batching runtime: ``n_slots`` (pow2)
 independent sequences live side by side in one slot-indexed KV cache, and
-exactly **three fixed-shape compiled programs** move them forward.  Slots
+a handful of **fixed-shape compiled programs** move them forward.  Slots
 are claimed and freed by the host scheduler (``serving/decode_loop.py``)
 between dispatches; no program ever retraces as requests come and go:
 
@@ -21,6 +21,12 @@ between dispatches; no program ever retraces as requests come and go:
   offsets already guarantee a new occupant never attends stale KV); this
   program is the failure-path hard isolation — after a poisoned request
   nothing about the slot's contents is trusted.
+* **slot snapshot / restore** — copy one slot's KV rows into stand-alone
+  device buffers and write them back into any (possibly different) free
+  slot.  This is the monolithic backend's O(1) preempt-resume: a
+  checkpointed victim re-enters decode without re-running a single
+  prefill chunk (the paged backend gets the same for free — its
+  checkpoint is a pinned page-table row).
 
 Bit-exactness contract: the cache layout deliberately mirrors the static
 path's slot/position split — the prompt occupies buffer rows
@@ -213,6 +219,50 @@ class SlotDecodeRuntime:
             )
             return caches, tokens, steps, done, emitted  # emitted [span, n]
 
+        def _snapshot_slot(caches, slot):
+            """Copy one slot's KV rows (every layer) and its write offset
+            into stand-alone device buffers — the checkpoint half of O(1)
+            preempt-resume (``serving/decode_loop.py``).
+
+            ``slot`` is a traced int32 scalar, so one compiled program
+            snapshots any slot.  The stacked ``[n_layers, max_total, ...]``
+            result lives on device until restored (or dropped), never
+            crossing to the host: checkpointing costs one device-side copy,
+            not a readback.
+            """
+            keys = jnp.stack([
+                jax.lax.dynamic_slice_in_dim(c.keys, slot, 1, axis=0)[0]
+                for c in caches
+            ])
+            values = jnp.stack([
+                jax.lax.dynamic_slice_in_dim(c.values, slot, 1, axis=0)[0]
+                for c in caches
+            ])
+            length = jax.lax.dynamic_slice_in_dim(
+                caches[0].length, slot, 1, axis=0
+            )[0]
+            return keys, values, length
+
+        def _restore_slot(caches, keys, values, slot, length):
+            """Write a snapshot back into (any) slot's rows — the restore
+            half of O(1) resume.  The buffer layout is identical across
+            slots and RoPE is already baked into the stored K/V bytes, so
+            a snapshot taken from one slot index replays byte-identically
+            from another.
+            """
+            new_caches = []
+            for li, c in enumerate(caches):
+                k = jax.lax.dynamic_update_slice(
+                    c.keys, keys[li][None], (slot, 0, 0, 0)
+                )
+                v = jax.lax.dynamic_update_slice(
+                    c.values, values[li][None], (slot, 0, 0, 0)
+                )
+                new_caches.append(
+                    KVCache(k, v, c.length.at[slot].set(length))
+                )
+            return new_caches
+
         def _free_slots(caches, free_mask):
             """Zero freed slots' KV rows and reset their write offsets.
 
@@ -234,6 +284,8 @@ class SlotDecodeRuntime:
         self.prefill_chunk = profiled_jit(_prefill_chunk, name="slots.prefill")
         self.decode_step = profiled_jit(_decode_step, name="slots.decode")
         self.free_slots = profiled_jit(_free_slots, name="slots.free")
+        self.snapshot_slot = profiled_jit(_snapshot_slot, name="slots.snapshot")
+        self.restore_slot = profiled_jit(_restore_slot, name="slots.restore")
 
     # ---------------------------------------------------------------- state
 
@@ -264,11 +316,12 @@ class SlotDecodeRuntime:
         return caches
 
     def compiled_variants(self) -> int:
-        """Total compiled-program count across the three programs — the
+        """Total compiled-program count across the five programs — the
         zero-retrace assertion reads this before/after a workload."""
         return sum(
             fn._cache_size()
-            for fn in (self.prefill_chunk, self.decode_step, self.free_slots)
+            for fn in (self.prefill_chunk, self.decode_step, self.free_slots,
+                       self.snapshot_slot, self.restore_slot)
         )
 
     def prompt_chunks(self, n_tokens: int) -> Sequence[int]:

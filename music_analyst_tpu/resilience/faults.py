@@ -10,7 +10,7 @@ Spec grammar (``--inject-faults`` / ``MUSICAAL_FAULTS``)::
 
     spec    := rule (';' rule)*
     rule    := site ':' mode trigger? ('seed=' int)?
-    mode    := 'error' | 'fatal' | 'delay=' seconds 's'?
+    mode    := 'error' | 'fatal' | 'crash' | 'delay=' seconds 's'?
     trigger := '@' N        -- trip exactly on the Nth call (1-based)
              | '@' N '+'    -- trip on every call from the Nth on
              | '@' P '%'    -- trip each call with probability P percent
@@ -21,11 +21,16 @@ Examples::
     ollama.request:error@2                 # 2nd HTTP attempt fails once
     h2d.transfer:delay=5s@0.1%seed=7       # seeded 0.1% per-transfer stall
     ingest.read:fatal                      # non-retryable, every call
+    serve.reply:crash@3                    # SIGKILL self before 3rd reply
 
 ``error`` raises :class:`InjectedFault` (classified retryable — the
 retry/failover machinery must recover); ``fatal`` raises
 :class:`InjectedFatal` (non-retryable — the run must die with a
-structured taxonomy error and no torn artifacts); ``delay`` sleeps.
+structured taxonomy error and no torn artifacts); ``delay`` sleeps;
+``crash`` SIGKILLs the process on the spot — no atexit, no flight
+record, no flushed buffers — the process-crash chaos primitive the
+``crash`` bench suite and the request journal's replay guarantees are
+drilled against (``serving/journal.py``).
 
 The module-level fast path matters: :func:`fault_point` sits on hot
 seams (per prefetch item, per serving dispatch), so with no spec
@@ -60,6 +65,13 @@ SITES = frozenset(
         "router.dispatch",
         "scheduler.preempt",
         "loadgen.tick",
+        # Crash-consistency seams (serving/journal.py, serving/server.py):
+        # post-admit, pre-reply, and the journal's own append/compaction
+        # paths — the four named SIGKILL points of the crash drill.
+        "serve.admit",
+        "serve.reply",
+        "journal.append",
+        "journal.compact",
     }
 )
 
@@ -154,7 +166,7 @@ def _parse_rule(text: str) -> FaultRule:
     mode_text, at, trigger = body.partition("@")
     mode_text = mode_text.strip()
     delay_s = 0.0
-    if mode_text in ("error", "fatal"):
+    if mode_text in ("error", "fatal", "crash"):
         mode = mode_text
     elif mode_text.startswith("delay="):
         mode = "delay"
@@ -173,8 +185,8 @@ def _parse_rule(text: str) -> FaultRule:
             )
     else:
         raise ValueError(
-            f"fault rule {text!r}: mode must be 'error', 'fatal' or "
-            f"'delay=<seconds>s', got {mode_text!r}"
+            f"fault rule {text!r}: mode must be 'error', 'fatal', 'crash' "
+            f"or 'delay=<seconds>s', got {mode_text!r}"
         )
 
     nth: Optional[int] = None
@@ -287,6 +299,17 @@ class FaultInjector:
         tel.count(f"faults.{site}.trips")
         if rule.mode == "delay":
             time.sleep(rule.delay_s)
+            return
+        if rule.mode == "crash":
+            # The real thing, not an exception anyone can catch: SIGKILL
+            # self, exactly as the OOM killer or a pulled cord would.  No
+            # flight record, no drain, no journal compaction — whatever
+            # recovery story the process claims must start from disk.
+            import os
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)
+            time.sleep(60.0)  # pragma: no cover — the signal lands first
             return
         if rule.mode == "fatal":
             raise InjectedFatal(site, call)

@@ -14,7 +14,10 @@ production-inference shape the ROADMAP north star asks for:
   integration (the ``serve`` CLI subcommand);
 * :mod:`music_analyst_tpu.serving.decode_loop` — continuous-batching
   decode scheduler (admit→prefill→decode over the slot-indexed KV cache
-  in ``ops/kv_slots.py``) hosting the ``generate`` op.
+  in ``ops/kv_slots.py``) hosting the ``generate`` op;
+* :mod:`music_analyst_tpu.serving.journal` — durable request journal
+  (CRC-framed WAL): replay admitted-but-unanswered requests after a
+  crash, dedup already-sent replies — exactly-once at the wire.
 """
 
 from music_analyst_tpu.serving.batcher import (
@@ -32,6 +35,10 @@ from music_analyst_tpu.serving.batcher import (
     resolve_slots,
 )
 from music_analyst_tpu.serving.decode_loop import ContinuousScheduler
+from music_analyst_tpu.serving.journal import (
+    RequestJournal,
+    resolve_journal_dir,
+)
 from music_analyst_tpu.serving.residency import ModelResidency, warmup_sizes
 from music_analyst_tpu.serving.server import (
     PROTOCOL,
@@ -51,9 +58,11 @@ __all__ = [
     "DynamicBatcher",
     "ModelResidency",
     "PROTOCOL",
+    "RequestJournal",
     "SentimentServer",
     "ServeRequest",
     "build_ops",
+    "resolve_journal_dir",
     "resolve_max_batch",
     "resolve_max_queue",
     "resolve_max_wait_ms",

@@ -49,9 +49,15 @@ the victim's fully-prefilled prompt pages are first adopted into the
 radix tree, its slot is released through the normal host-side free path
 (no device zeroing: nothing faulted, so the reuse invariants hold), and
 the original request is requeued at the head of its tenant queue.
-Resume is then a prefix hit plus the boundary/final chunk re-prefill;
-greedy decode is deterministic, so the preempted-then-resumed tokens are
-byte-identical to the undisturbed run at zero retraces.  An injected
+Resume is **O(1)**: preemption checkpoints the victim's decode state
+(paged — a pinned copy of its page-table row; monolithic — a device-side
+copy of its slot rows via ``slots.snapshot``), and re-admission restores
+it straight into decode with zero prefill chunks.  A periodic checkpoint
+tick (``MUSICAAL_SERVE_CKPT_INTERVAL`` decode dispatches) additionally
+bounds the work a failed dispatch loses: a resubmitted request id
+resumes from the last checkpoint instead of the prompt.  Greedy decode
+is deterministic, so resumed tokens are byte-identical to the
+undisturbed run at zero retraces.  An injected
 ``scheduler.preempt`` fault aborts the steal BEFORE any state mutation —
 the degraded mode is "no steal this tick", never a half-zeroed slot.  A
 TPOT target (``--tpot-slo-ms``) throttles new admissions while the
@@ -61,8 +67,10 @@ instead of letting every resident stream miss together.
 
 from __future__ import annotations
 
+import json
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -75,6 +83,7 @@ from music_analyst_tpu.serving.batcher import (
     _LATENCY_BUCKETS,
     _OCCUPANCY_BUCKETS,
     _RETRY_AFTER_CAP_MS,
+    _resolve,
     DEFAULT_TENANT,
     ServeRequest,
     resolve_kv_pages,
@@ -124,6 +133,47 @@ class _Slot:
         self.skipped = 0           # paged: prefill chunks skipped by the hit
 
 
+def _ckpt_key(rid: Any) -> str:
+    """Canonical checkpoint-registry key for an arbitrary JSON request id
+    (same canonicalization as the journal's dedup index)."""
+    try:
+        return json.dumps(rid, sort_keys=True, separators=(",", ":"))
+    except (TypeError, ValueError):
+        return repr(rid)
+
+
+class _Checkpoint:
+    """O(1)-resume snapshot of one in-flight generation.
+
+    Taken at preemption and on the periodic checkpoint tick; holds the
+    host progress fields (emitted tokens, step/carry/done) plus the KV
+    needed to re-enter decode without a single prefill chunk: the paged
+    backend pins the victim's page-table row (its own refcount, so the
+    row survives the slot's release *and* the zeroing failure path, which
+    only touches fully-unreferenced pages); the monolithic backend keeps
+    a device-side copy of the slot's rows (``slots.snapshot``).  The KV
+    lives on the device only — a SIGKILL still loses it, so cross-crash
+    journal replay recomputes from the prompt (byte-identical greedy
+    text); O(1) resume is the in-process guarantee.
+    """
+
+    __slots__ = ("key", "ids", "plen", "budget", "steps", "tokens",
+                 "carry", "done", "t_first", "pages", "kv")
+
+    def __init__(self, key: str, slot: "_Slot") -> None:
+        self.key = key
+        self.ids = slot.ids
+        self.plen = slot.plen
+        self.budget = slot.budget
+        self.steps = slot.steps
+        self.tokens = list(slot.tokens)
+        self.carry = slot.carry
+        self.done = slot.done
+        self.t_first = slot.t_first
+        self.pages: Optional[List[int]] = None  # paged: pinned row copy
+        self.kv: Optional[Any] = None  # monolithic: (keys, values, length)
+
+
 class ContinuousScheduler:
     """Admit→prefill→decode loop over a backend's slot runtime.
 
@@ -150,6 +200,7 @@ class ContinuousScheduler:
         tpot_slo_ms: Optional[float] = None,
         tenant_budget: Optional[float] = None,
         priority: Optional[int] = None,
+        checkpoint_interval: Optional[int] = None,
     ) -> None:
         self.backend = backend
         self.n_slots = resolve_slots(n_slots)
@@ -159,6 +210,14 @@ class ContinuousScheduler:
         self.tpot_slo_ms = resolve_tpot_slo_ms(tpot_slo_ms)
         self.tenant_budget = resolve_tenant_budget(tenant_budget)
         self.default_priority = resolve_priority(priority)
+        # Decode dispatches between periodic checkpoint refreshes (0 =
+        # preemption-time checkpoints only).  At the default span a short
+        # generation completes before the first tick fires, so the tick
+        # costs nothing until requests are long enough to need it.
+        self.checkpoint_interval = int(_resolve(
+            checkpoint_interval, "MUSICAAL_SERVE_CKPT_INTERVAL", 32,
+            integer=True, minimum=0,
+        ))
         page = resolve_page_size(page_size)
         self.paged = bool(page) and hasattr(backend, "paged_runtime")
         if self.paged:
@@ -221,11 +280,18 @@ class ContinuousScheduler:
             "decode_dispatches": 0, "decode_seconds": 0.0,
             "queue_depth_max": 0,
             "preemptions": 0, "preempt_faults": 0, "resumed": 0,
+            "checkpoints_taken": 0, "checkpoints_released": 0,
+            "resumed_o1": 0, "resume_chunks_skipped": 0,
             "tpot_throttle_ticks": 0, "ttft_slo_misses": 0,
             "tpot_slo_misses": 0, "retry_after_ms_last": None,
             "shed_queue_full": 0, "shed_slo_unattainable": 0,
             "shed_tenant_budget": 0, "shed_evicted": 0,
         }
+        # Live checkpoints keyed by canonical request id, oldest first.
+        # Bounded (LRU release) so abandoned checkpoints can't pin the
+        # page pool or hold monolithic KV copies forever.
+        self._ckpts: "OrderedDict[str, _Checkpoint]" = OrderedDict()
+        self._ckpt_limit = 2 * self.plan.n_slots
         # Per-tenant admission ledger (manifest ``serving.slo`` section).
         self._tenants: Dict[str, Dict[str, int]] = {}
         # TTFT/TPOT EWMAs (seconds): the drain estimate behind
@@ -339,6 +405,15 @@ class ContinuousScheduler:
             )
             self.caches = self.runtime.free_slots(
                 self.caches, jnp.ones((n,), bool)
+            )
+            # Checkpoint pair (O(1) preempt-resume): snapshot a zeroed
+            # slot and restore it in place — compiles both programs, no
+            # residue.
+            snap_k, snap_v, snap_len = self.runtime.snapshot_slot(
+                self.caches, zero
+            )
+            self.caches = self.runtime.restore_slot(
+                self.caches, snap_k, snap_v, zero, snap_len
             )
         warm_s = time.perf_counter() - t0
         after = tel.compile_stats()
@@ -588,6 +663,15 @@ class ContinuousScheduler:
                 return did
             if req.done:  # already shed/settled
                 continue
+            # A re-admitted request with a live checkpoint (preempted
+            # victim, or a failed/replayed id resubmitted) skips tokenize,
+            # page mapping, and every prefill chunk: O(1) resume.
+            if self._ckpts:
+                ck = self._ckpts.pop(_ckpt_key(req.id), None)
+                if ck is not None:
+                    self._resume(free, req, ck)
+                    did = True
+                    continue
             try:
                 ids, plen = self.backend.tokenizer.encode(
                     req.text, self.plan.prompt_region
@@ -602,15 +686,25 @@ class ContinuousScheduler:
                 req, np.asarray(ids, np.int32), plen,
                 req.meta.get("max_new_tokens", self.plan.max_new),
             )
-            if self.paged and not self._map_pages(free, slot):
-                # Not even eviction could free enough pages: put the
-                # request back and stop admitting this tick — in-flight
-                # sequences completing will release pages.
-                with self._cond:
-                    self._queue.requeue(req)
-                with self._stats_lock:
-                    self._prefix["deferred"] += 1
-                return did
+            if self.paged:
+                mapped = self._map_pages(free, slot)
+                # Pressure valve: live checkpoints pin pages eviction
+                # can't touch — release the oldest until the admit fits
+                # (a released checkpoint degrades its owner to prefix-hit
+                # / full re-prefill resume: slower, still byte-identical).
+                while not mapped and self._ckpts:
+                    _, stale = self._ckpts.popitem(last=False)
+                    self._release_ckpt(stale)
+                    mapped = self._map_pages(free, slot)
+                if not mapped:
+                    # Not even eviction could free enough pages: put the
+                    # request back and stop admitting this tick — in-flight
+                    # sequences completing will release pages.
+                    with self._cond:
+                        self._queue.requeue(req)
+                    with self._stats_lock:
+                        self._prefix["deferred"] += 1
+                    return did
             self._slots[free] = slot
             did = True
         return did
@@ -625,13 +719,13 @@ class ContinuousScheduler:
         (``scheduler.preempt``) sits BEFORE any state mutation, so a
         fault degrades to no steal at all — never a half-released slot.
         The steal itself is the normal completion path run early: adopt
-        the fully-prefilled prompt pages into the radix tree, requeue
-        the request at the head of its tenant queue, release the slot
-        host-side (no device zeroing — nothing faulted, so the reuse
-        invariants hold).  Resume re-runs the request from scratch
-        (prefix hit + boundary chunk on the paged backend, full prefill
-        on the monolithic one); greedy decode is deterministic, so the
-        resumed tokens are byte-identical to an undisturbed run.
+        the fully-prefilled prompt pages into the radix tree, checkpoint
+        the victim's decode state, requeue the request at the head of
+        its tenant queue, release the slot host-side (no device zeroing
+        — nothing faulted, so the reuse invariants hold).  Resume
+        restores the checkpoint into the next free slot in O(1) — zero
+        prefill chunks; greedy decode is deterministic, so the resumed
+        tokens are byte-identical to an undisturbed run.
         """
         if self.ttft_slo_ms <= 0.0:
             return None
@@ -669,6 +763,10 @@ class ContinuousScheduler:
             return None
         if self.paged and self._radix is not None:
             self._adopt(victim)  # no-op when prefill already adopted them
+        # Checkpoint BEFORE the slot is released: the victim re-enters
+        # decode in O(1) (zero prefill chunks) when its turn comes back.
+        if victim.active:
+            self._checkpoint(idx, victim)
         victim.req.meta["preempted"] = (
             victim.req.meta.get("preempted", 0) + 1
         )
@@ -822,6 +920,98 @@ class ContinuousScheduler:
         if adopted:
             with self._stats_lock:
                 self._prefix["adopted_pages"] += adopted
+
+    # -------------------------------------------------------- checkpoints
+
+    def _checkpoint(self, idx: int, slot: _Slot) -> None:
+        """Snapshot one resident slot's decode state for O(1) resume.
+
+        Paged: pin the slot's page-table row once more — the checkpoint's
+        own refcount, so adoption/eviction/slot-release can't recycle the
+        pages under it.  Monolithic: copy the slot's KV rows into
+        stand-alone device buffers (``slots.snapshot``; no host readback).
+        Replacing an existing checkpoint for the same request releases the
+        stale one first; the registry is LRU-bounded so orphans (a client
+        that never resubmits a failed id) can't pin memory forever.
+        """
+        import jax.numpy as jnp
+
+        key = _ckpt_key(slot.req.id)
+        old = self._ckpts.pop(key, None)
+        if old is not None:
+            self._release_ckpt(old)
+        ck = _Checkpoint(key, slot)
+        if self.paged:
+            self._pool.pin_row(slot.pages)
+            ck.pages = list(slot.pages)
+        else:
+            ck.kv = self.runtime.snapshot_slot(
+                self.caches, jnp.asarray(idx, jnp.int32)
+            )
+        self._ckpts[key] = ck
+        while len(self._ckpts) > self._ckpt_limit:
+            _, evicted = self._ckpts.popitem(last=False)
+            self._release_ckpt(evicted)
+        self._bump(checkpoints_taken=1)
+        get_telemetry().count("serving.checkpoints_taken")
+
+    def _release_ckpt(self, ck: _Checkpoint) -> None:
+        """Drop a checkpoint's KV hold (unpin the row / free the copy)."""
+        if ck.pages is not None and self._pool is not None:
+            self._pool.unpin_row(ck.pages)
+        ck.pages = None
+        ck.kv = None
+        self._bump(checkpoints_released=1)
+
+    def _drop_ckpt_for(self, req: ServeRequest) -> None:
+        """A settled request never resumes — release its checkpoint."""
+        if not self._ckpts:
+            return
+        ck = self._ckpts.pop(_ckpt_key(req.id), None)
+        if ck is not None:
+            self._release_ckpt(ck)
+
+    def _resume(self, idx: int, req: ServeRequest, ck: _Checkpoint) -> None:
+        """Re-enter decode from a checkpoint in O(1) — zero prefill chunks.
+
+        Paged: write the checkpointed row back into the table; the
+        checkpoint's page pins transfer to the slot (the release path
+        unpins exactly once either way).  Monolithic: ``slots.restore``
+        writes the KV copy into the granted slot — any slot, the layout
+        is slot-index independent.  Greedy decode then continues from the
+        checkpointed step/carry/done, so the remaining tokens are
+        byte-identical to an undisturbed run.
+        """
+        import jax.numpy as jnp
+
+        slot = _Slot(req, ck.ids, ck.plen, ck.budget)
+        slot.tokens = list(ck.tokens)
+        slot.steps = ck.steps
+        slot.carry = ck.carry
+        slot.done = ck.done
+        slot.t_first = ck.t_first
+        slot.next_chunk = -1  # fully prefilled: straight to decode
+        slot.active = True
+        chunks = len(self.runtime.prompt_chunks(ck.plen))
+        slot.skipped = chunks
+        if self.paged:
+            row = list(ck.pages)
+            ck.pages = None  # pins transfer to the slot — no unpin here
+            self._table[idx] = np.asarray(row, np.int32)
+            slot.pages = row
+            slot.kv_shared = ck.plen
+            with self._stats_lock:
+                self._prefix["chunks_skipped"] += chunks
+        else:
+            keys, values, length = ck.kv
+            ck.kv = None
+            self.caches = self.runtime.restore_slot(
+                self.caches, keys, values, jnp.asarray(idx, jnp.int32),
+                length,
+            )
+        self._slots[idx] = slot
+        self._bump(resumed_o1=1, resume_chunks_skipped=chunks)
+        get_telemetry().count("serving.resumed_o1")
 
     # ------------------------------------------------------------ prefill
 
@@ -1007,6 +1197,17 @@ class ContinuousScheduler:
             saw_eos = emitted_n > 0 and self.runtime.eos_id in s.tokens[-emitted_n:]
             if saw_eos or s.steps >= s.budget:
                 freed.append(i)
+        # Periodic checkpoint tick: refresh still-running slots so a
+        # later failure loses at most ``checkpoint_interval`` dispatches
+        # of work — a resubmitted id resumes from here, not the prompt.
+        if self.checkpoint_interval > 0:
+            with self._stats_lock:
+                dispatches = self._stats["decode_dispatches"]
+            if dispatches % self.checkpoint_interval == 0:
+                settling = set(freed)
+                for i, s in occupied:
+                    if i not in settling:
+                        self._checkpoint(i, s)
         for i in freed:
             self._settle(i, self._slots[i])
         return True
@@ -1049,6 +1250,7 @@ class ContinuousScheduler:
         tel.count("serving.decode_completed")
         tel.observe("serving.request_seconds", now - slot.req.t_enqueue,
                     buckets=_LATENCY_BUCKETS)
+        self._drop_ckpt_for(slot.req)
         self._free([idx])
 
     def _free(self, indices: List[int], zero: bool = False) -> None:
@@ -1155,6 +1357,8 @@ class ContinuousScheduler:
             compiled_variants=self.runtime.compiled_variants(),
             warmup=self._warmup_record,
             kv_backend="paged" if self.paged else "slots",
+            checkpoint_interval=self.checkpoint_interval,
+            checkpoints_live=len(self._ckpts),
         )
         out["ttft_ewma_ms"] = round(self._ttft_ewma_s * 1000.0, 3)
         out["tpot_ewma_ms"] = round(self._tpot_ewma_s * 1000.0, 3)

@@ -706,11 +706,20 @@ class ReplicaRouter:
                 continue
             attempts = req.meta.get("router_attempts", 0) + 1
             req.meta["router_attempts"] = attempts
+            # Per-request hop trail: every replica that lost this request,
+            # with the loss kind — the terminal error below replays the
+            # request's whole journey instead of naming only the last hop.
+            hops = req.meta.setdefault("router_hops", [])
+            hops.append({"replica": handle.name, "kind": kind})
             if attempts > self.redispatch_limit:
+                hint_ms = self.retry_after_ms()
                 req.fail(
                     "replica_lost",
                     f"replica {handle.name} lost ({kind}) and the request "
                     f"exceeded {self.redispatch_limit} re-dispatches",
+                    hops=attempts,
+                    hop_trail=list(hops),
+                    retry_after_ms=hint_ms,
                 )
                 self._bump(failed=1)
                 continue
@@ -903,6 +912,7 @@ def _replica_cmd(
     tpot_slo_ms: Optional[float] = None,
     tenant_budget: Optional[float] = None,
     priority: Optional[int] = None,
+    journal_dir: Optional[str] = None,
 ) -> List[str]:
     cmd = [
         sys.executable, "-m", "music_analyst_tpu", "serve",
@@ -927,6 +937,7 @@ def _replica_cmd(
         ("--tpot-slo-ms", tpot_slo_ms),
         ("--tenant-budget", tenant_budget),
         ("--priority", priority),
+        ("--journal-dir", journal_dir),
     ):
         if value is not None:
             cmd += [flag, str(value)]
@@ -957,6 +968,7 @@ def spawn_replicas(
     tpot_slo_ms: Optional[float] = None,
     tenant_budget: Optional[float] = None,
     priority: Optional[int] = None,
+    journal_dir: Optional[str] = None,
 ) -> List[ReplicaHandle]:
     """Start ``n`` worker server processes and (optionally) connect.
 
@@ -965,17 +977,28 @@ def spawn_replicas(
     — fleet-level stats live in the router's manifest section.  Each
     handle keeps its spawn cmd, so the router's supervised respawn can
     relaunch a dead worker in place.
+
+    With ``journal_dir`` set, each worker gets its own subdirectory
+    (``replica-<i>/``) passed explicitly on its command line — the
+    explicit flag outranks any inherited ``MUSICAAL_SERVE_JOURNAL``, so
+    replicas never share (and corrupt) one journal, and a supervised
+    respawn relaunches the same cmd, pointing the new process at the
+    dead one's journal to replay its unanswered requests.
     """
     handles: List[ReplicaHandle] = []
     try:
         for i in range(n):
             socket_path = os.path.join(base_dir, f"replica-{i}.sock")
+            replica_journal = None
+            if journal_dir:
+                replica_journal = os.path.join(journal_dir, f"replica-{i}")
             cmd = _replica_cmd(
                 socket_path, model, mock, weight_quant, tp, max_batch,
                 max_wait_ms, max_queue, slots, prefill_chunk,
                 max_new_tokens, page_size, kv_pages, warmup,
                 ttft_slo_ms=ttft_slo_ms, tpot_slo_ms=tpot_slo_ms,
                 tenant_budget=tenant_budget, priority=priority,
+                journal_dir=replica_journal,
             )
             proc = subprocess.Popen(
                 cmd,
@@ -1020,6 +1043,7 @@ def run_router(
     tpot_slo_ms: Optional[float] = None,
     tenant_budget: Optional[float] = None,
     priority: Optional[int] = None,
+    journal_dir: Optional[str] = None,
 ) -> int:
     """``serve --replicas N`` (N > 1): spawn the fleet, route until
     drained.  The front end is a stock ``SentimentServer`` with the
@@ -1030,9 +1054,15 @@ def run_router(
 
     from music_analyst_tpu.serving.server import SentimentServer
 
+    from music_analyst_tpu.serving.journal import resolve_journal_dir
+
     tel = get_telemetry()
     n = resolve_replicas(replicas)
     tp_width = resolve_tp(tp)
+    # Resolve here (flag beats $MUSICAAL_SERVE_JOURNAL) so the fleet gets
+    # per-replica subdirectories; workers inherit the env, and without an
+    # explicit per-worker flag they would all journal into the same dir.
+    journal_base = resolve_journal_dir(journal_dir)
     with tel.run_scope("serve", None):
         with tempfile.TemporaryDirectory(prefix="musicaal-fleet-") as base:
             handles = spawn_replicas(
@@ -1044,6 +1074,7 @@ def run_router(
                 kv_pages=kv_pages, warmup=warmup,
                 ttft_slo_ms=ttft_slo_ms, tpot_slo_ms=tpot_slo_ms,
                 tenant_budget=tenant_budget, priority=priority,
+                journal_dir=journal_base,
             )
             router = ReplicaRouter(
                 handles, max_queue=max_queue, ttft_slo_ms=ttft_slo_ms,
@@ -1056,6 +1087,8 @@ def run_router(
             tel.annotate(
                 serve_mode=server.mode, router_replicas=n, router_tp=tp_width,
             )
+            if journal_base:
+                tel.annotate(journal_dir=journal_base)
             if not quiet:
                 print(
                     f"serve: routing over {n} replica(s) (tp={tp_width})",

@@ -44,12 +44,14 @@ from __future__ import annotations
 
 import collections
 import json
+import os
 import queue
 import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from music_analyst_tpu.resilience.faults import fault_point
 from music_analyst_tpu.serving.batcher import (
     DynamicBatcher,
     ServeRequest,
@@ -57,6 +59,10 @@ from music_analyst_tpu.serving.batcher import (
     resolve_max_queue,
     resolve_max_wait_ms,
     resolve_tp,
+)
+from music_analyst_tpu.serving.journal import (
+    RequestJournal,
+    resolve_journal_dir,
 )
 from music_analyst_tpu.serving.residency import ModelResidency
 from music_analyst_tpu.telemetry import get_telemetry
@@ -125,9 +131,15 @@ class SentimentServer:
         mode: str = "stdio",
         decode=None,
         router=None,
+        journal: Optional[RequestJournal] = None,
     ) -> None:
         self.batcher = batcher
         self.residency = residency
+        # Durable request journal (serving/journal.py): admitted records
+        # write ahead of dispatch, replied records fsync ahead of the
+        # wire, and re-dispatched ids settle from the dedup index instead
+        # of recomputing.  None = the historical non-durable behavior.
+        self.journal = journal
         # Optional ContinuousScheduler hosting the ``generate`` op; None
         # when the backend has no slot runtime (e.g. --mock) — generate
         # requests then settle as bad_request instead of crashing.
@@ -244,6 +256,7 @@ class SentimentServer:
             return req
         slo = {"tenant": tenant, "priority": priority,
                "deadline_ms": deadline_ms}
+        budget = None
         if op == "generate":
             if self.decode is None:
                 req = ServeRequest(rid, op, text)
@@ -259,6 +272,26 @@ class SentimentServer:
                 req.fail("bad_request",
                          "'max_new_tokens' must be an integer")
                 return req
+        if self.journal is not None:
+            # Exactly-once at the wire: a re-dispatched id whose reply is
+            # journaled settles from the dedup index — nothing recomputes.
+            deduped = self.journal.lookup_reply(rid)
+            if deduped is not None:
+                req = ServeRequest(rid, op, text)
+                deduped["id"] = rid
+                req.complete(deduped)
+                return req
+            self.journal.record_admitted(
+                rid, op, text, tenant=tenant, priority=priority,
+                deadline_ms=deadline_ms,
+                meta=(
+                    {"max_new_tokens": budget} if budget is not None else {}
+                ),
+            )
+        # Post-admit crash seam: admission journaled, no reply yet — a
+        # SIGKILL here must replay the request on restart.
+        fault_point("serve.admit", op=op)
+        if op == "generate":
             return self.decode.submit(rid, text, max_new_tokens=budget,
                                       **slo)
         return self.batcher.submit(rid, op, text, **slo)
@@ -297,35 +330,75 @@ class SentimentServer:
 
         written = 0
         eof = False
+        pending: "collections.deque[ServeRequest]" = collections.deque()
+
+        def _pull(block: bool) -> None:
+            """Drain the reader's queue into ``pending`` (arrival order
+            preserved), folding the EOF sentinel into the flag."""
+            nonlocal eof
+            try:
+                item = order.get(timeout=0.05) if block else \
+                    order.get_nowait()
+            except queue.Empty:
+                return
+            while True:
+                if item is _EOF:
+                    eof = True
+                    if drain_on_eof:
+                        self.request_drain("eof", record=False)
+                        self._drain_batcher()
+                else:
+                    pending.append(item)
+                try:
+                    item = order.get_nowait()
+                except queue.Empty:
+                    return
+
         while True:
             if self.drain_event.is_set():
                 # Admission is closed; everything already queued settles
                 # once the batcher finishes its flush.
                 self._drain_batcher()
-            try:
-                item = order.get(timeout=0.05)
-            except queue.Empty:
+            _pull(block=not pending)
+            if not pending:
                 if eof or (self.drain_event.is_set() and order.empty()):
                     break
                 continue
-            if item is _EOF:
-                eof = True
-                if drain_on_eof:
-                    self.request_drain("eof", record=False)
-                    self._drain_batcher()
-                if order.empty():
-                    break
-                continue
-            req: ServeRequest = item
+            req: ServeRequest = pending.popleft()
             # Bounded waits so a drain can't strand the writer; the
             # batcher answers every admitted request on drain.
             while not req.wait(timeout=0.2):
                 if self.drain_event.is_set():
                     self._drain_batcher()
-            with tel.span("serve.reply", op=req.op):
-                wfile.write(json.dumps(req.response) + "\n")
-                wfile.flush()
-            written += 1
+            # Group commit: the settled head plus every already-settled
+            # successor (one dynamic batch usually settles together)
+            # journal their replies under ONE fsync, then the lines go
+            # out in arrival order — the per-reply durability barrier
+            # (record durable BEFORE its line hits the wire, so any
+            # reply a client ever saw is deduplicable after a crash,
+            # and one a crash ate is recomputed, never duplicated) at
+            # amortized fsync cost.
+            batch = [req]
+            while pending and pending[0].done:
+                batch.append(pending.popleft())
+            journaled = False
+            for settled in batch:
+                # Pre-reply crash seam, then the durability barrier.
+                fault_point("serve.reply", op=settled.op)
+                if self.journal is not None and settled.op not in (
+                    "ping", "stats", "shutdown", "invalid",
+                ):
+                    self.journal.record_replied(
+                        settled.id, settled.response, sync=False
+                    )
+                    journaled = True
+            if journaled:
+                self.journal.sync()
+            for settled in batch:
+                with tel.span("serve.reply", op=settled.op):
+                    wfile.write(json.dumps(settled.response) + "\n")
+                    wfile.flush()
+                written += 1
         stop_reading.set()
         return written
 
@@ -399,6 +472,8 @@ class SentimentServer:
             out["residency"] = self.residency.snapshot()
         if self.router is not None:
             out["router"] = self.router.stats()
+        if self.journal is not None:
+            out["journal"] = self.journal.stats()
         # SLO layer (serving/slo.py) — only-when-used, like the
         # corpus-cache manifest section: empty snapshots stay out.
         slo: Dict[str, Any] = {}
@@ -417,6 +492,68 @@ class SentimentServer:
 
 
 # ----------------------------------------------------------------- CLI glue
+
+
+def _replay_journal(journal: RequestJournal, batcher, decode,
+                    unanswered: List[Dict[str, Any]]) -> int:
+    """Answer every admitted-but-unanswered journaled request before
+    taking live traffic.  Ops are pure functions of their text, so the
+    recompute is byte-identical to the reply the crash ate; journaling
+    it makes a reconnecting client's re-submit settle from the dedup
+    index."""
+    if not unanswered:
+        return 0
+    reqs: List[ServeRequest] = []
+    for record in unanswered:
+        rid = record.get("id")
+        op = record.get("op")
+        text = record.get("text") or ""
+        meta = record.get("meta") or {}
+        slo = dict(
+            tenant=record.get("tenant"),
+            priority=record.get("priority"),
+            deadline_ms=None,  # the journaled deadline already elapsed
+        )
+        if op == "generate":
+            if decode is None:
+                req = ServeRequest(rid, op, text)
+                req.fail(
+                    "request_failed",
+                    "journaled generate request replayed on a server "
+                    "without a decode runtime",
+                )
+            else:
+                req = decode.submit(
+                    rid, text,
+                    max_new_tokens=meta.get("max_new_tokens"), **slo,
+                )
+        else:
+            req = batcher.submit(rid, op or "invalid", text, **slo)
+        reqs.append(req)
+    for req in reqs:
+        req.wait(timeout=60.0)
+        if req.done:
+            journal.record_replied(req.id, req.response)
+    get_telemetry().count("journal.replayed", len(reqs))
+    return len(reqs)
+
+
+def _stale_flight_witness() -> bool:
+    """The second unclean witness: a flight record already in the
+    telemetry dir from a PREVIOUS process whose reason was not a
+    graceful drain (SIGKILL writes none, but a fatal crash/watchdog dump
+    survives the restart)."""
+    directory = get_telemetry().directory
+    if not directory:
+        return False
+    path = os.path.join(directory, "flight_record.json")
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            record = json.load(fh)
+    except (OSError, ValueError):
+        return False
+    reason = str(record.get("reason") or "")
+    return not reason.startswith("serve_drain")
 
 
 def serve_mesh(tp: Optional[int]):
@@ -460,6 +597,7 @@ def run_server(
     tpot_slo_ms: Optional[float] = None,
     tenant_budget: Optional[float] = None,
     priority: Optional[int] = None,
+    journal_dir: Optional[str] = None,
 ) -> int:
     """The ``serve`` subcommand: load, warm, then serve until drained.
 
@@ -469,6 +607,35 @@ def run_server(
     tel = get_telemetry()
     resolved_batch = resolve_max_batch(max_batch)
     with tel.run_scope("serve", None):
+        # Crash-consistency first: open the journal (replaying its state)
+        # and check both unclean witnesses BEFORE any work this run could
+        # overwrite them — the journal's missing clean marker (SIGKILL
+        # writes no flight record, so the journal is the witness) and a
+        # stale non-drain flight record from the previous process.
+        journal: Optional[RequestJournal] = None
+        unanswered: List[Dict[str, Any]] = []
+        stale_flight = _stale_flight_witness()
+        journal_path = resolve_journal_dir(journal_dir)
+        if journal_path:
+            journal = RequestJournal(journal_path)
+            unanswered = journal.recover()
+        unclean_journal = (
+            journal is not None and journal.stats()["unclean_start"]
+        )
+        if unclean_journal or stale_flight:
+            witness = "journal" if unclean_journal else "flight_record"
+            tel.annotate(
+                unclean_shutdown=True,
+                unclean_witness=witness,
+            )
+            tel.event("unclean_shutdown_detected", witness=witness,
+                      replayed=len(unanswered))
+            if not quiet:
+                print(
+                    f"serve: unclean shutdown detected ({witness}); "
+                    f"{len(unanswered)} journaled request(s) to replay",
+                    file=sys.stderr,
+                )
         residency = ModelResidency(
             model=model, mock=mock, weight_quant=weight_quant,
             backend=backend, mesh=serve_mesh(tp),
@@ -528,8 +695,18 @@ def run_server(
             decode.start()
         server = SentimentServer(
             batcher, residency, mode="stdio" if stdio else "unix",
-            decode=decode,
+            decode=decode, journal=journal,
         )
+        # Replay BEFORE live traffic: every journaled-but-unanswered
+        # request settles (and its reply journals) so reconnecting
+        # clients dedup instead of recomputing.
+        if journal is not None and unanswered:
+            replayed = _replay_journal(journal, batcher, decode, unanswered)
+            if not quiet:
+                print(
+                    f"serve: replayed {replayed} journaled request(s)",
+                    file=sys.stderr,
+                )
         tel.annotate(
             backend=getattr(clf, "name", "injected"),
             serve_mode=server.mode,
@@ -538,6 +715,7 @@ def run_server(
             max_queue=batcher.max_queue,
             decode_slots=(decode.plan.n_slots if decode is not None else 0),
             serve_tp=resolve_tp(tp),
+            journal_dir=journal_path,
         )
 
         # Graceful SIGTERM/SIGINT: drain instead of dying.  The flight
@@ -591,6 +769,11 @@ def run_server(
                     signal.signal(signum, prev)
                 except (ValueError, OSError):
                     pass
+            # Graceful shutdown compacts the journal and writes the clean
+            # marker — the exact step a SIGKILL cannot take, which is how
+            # the next start detects it.
+            if journal is not None:
+                journal.close()
             stats = server.stats_snapshot()
             tel.gauge("serving.requests_total",
                       stats["requests"]["admitted"])

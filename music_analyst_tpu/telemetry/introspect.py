@@ -165,6 +165,14 @@ def write_run_manifest(
         for key in ("degraded_site", "degraded_reason"):
             if key in context:
                 manifest[key] = context[key]
+    # An unclean previous shutdown (journal without its clean marker, or
+    # a stale non-drain flight record) is the same class of headline
+    # fact: hoisted so telemetry-report and operators see it at a glance,
+    # absent on runs that started clean.
+    if context.get("unclean_shutdown"):
+        manifest["unclean_shutdown"] = True
+        if "unclean_witness" in context:
+            manifest["unclean_witness"] = context["unclean_witness"]
     try:
         # Fault-injection + retry digest (resilience/): per-site trips and
         # per-site retry/recovery counts — only when something tripped or

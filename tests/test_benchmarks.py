@@ -21,7 +21,7 @@ def test_every_advertised_module_registers(monkeypatch):
     for expected in (
         "roofline", "flash_sweep", "generation", "coldstart", "ingest",
         "scaling", "joint", "llama_zeroshot", "sentiment_int8", "bucketing",
-        "overlap", "streaming", "serving", "router", "slo",
+        "overlap", "streaming", "serving", "router", "slo", "crash",
     ):
         assert expected in names
 

@@ -391,7 +391,8 @@ def test_ttft_tpot_quantiles_populated(clf):
 def test_decode_warmup_compiles_before_first_request(clf):
     # Default backend is the paged cache: four fixed programs (prefill,
     # decode, free, copy-on-write).  page_size=0 pins PR 10's monolithic
-    # slot cache and its three.
+    # slot cache and its five (prefill, decode, free, plus the
+    # checkpoint snapshot/restore pair).
     sched = _scheduler(clf, n_slots=2)
     record = sched.warmup()
     assert record["kv_backend"] == "paged"
@@ -403,4 +404,4 @@ def test_decode_warmup_compiles_before_first_request(clf):
     mono = _scheduler(clf, n_slots=2, page_size=0)
     record = mono.warmup()
     assert record["kv_backend"] == "slots"
-    assert record["programs"] == 3
+    assert record["programs"] == 5

@@ -1,0 +1,420 @@
+"""Crash-consistent serving: the durable request journal (ISSUE 14).
+
+Contract families:
+
+* **WAL framing** — length+CRC framed records; a torn tail or bit-rot is
+  counted (``corrupt_truncated``), the segment's tail abandoned, and
+  replay carries on — corruption degrades to recompute, never to a wrong
+  or duplicate answer.
+* **replay + dedup** — admitted-but-unanswered records come back from
+  :meth:`recover` oldest-first; replied ids hit the bounded dedup index
+  (exactly-once at the wire); records are idempotent upserts, so replay
+  of compacted + live history converges to one state.
+* **durability protocol** — ``atomic_write(durable=True)`` fsyncs the
+  staged file BEFORE the rename and the directory after (the regression
+  pinned here: rename-only publication is not a write barrier); reply
+  records group-commit (append the batch, one fsync, then the wire).
+* **unclean detection** — the ``clean`` marker is the dirty bit: absent
+  marker + segments on disk means the previous process never ran its
+  shutdown path.
+* **O(1) resume** — a preempted decode resumes from its checkpoint with
+  zero prefill chunks (``resumed_o1``/``resume_chunks_skipped``), greedy
+  tokens byte-identical, zero retraces, on BOTH KV backends; a drain
+  that lands while the victim is still waiting answers every admitted
+  request (the SIGTERM × preemption seam).
+* **crash drill** — the subprocess SIGKILL/restart drill from the
+  ``crash`` bench suite, one cheap seam, asserted as a test.
+"""
+
+import json
+import os
+import struct
+import zlib
+
+import pytest
+
+from music_analyst_tpu.serving.journal import RequestJournal
+from music_analyst_tpu.utils.atomic import atomic_write
+
+_HEADER = struct.Struct(">II")
+
+
+def _segments(directory):
+    return sorted(
+        name for name in os.listdir(directory)
+        if name.startswith("journal-") and name.endswith(".log")
+    )
+
+
+def _active_segment(directory):
+    return os.path.join(directory, _segments(directory)[-1])
+
+
+# ------------------------------------------------------------ WAL basics
+
+
+def test_recover_replays_unanswered_and_dedups_replied(tmp_path):
+    d = str(tmp_path / "wal")
+    j = RequestJournal(d)
+    assert j.recover() == []  # first boot: nothing to replay, not unclean
+    assert j.stats()["unclean_start"] is False
+    j.record_admitted("a", "sentiment", "sunny day", tenant="gold",
+                      priority=3)
+    j.record_admitted("b", "wordcount", "la la la")
+    j.record_replied("a", {"ok": True, "label": "Positive"})
+    j.close()
+
+    j2 = RequestJournal(d)
+    unanswered = j2.recover()
+    assert [r["id"] for r in unanswered] == ["b"]
+    assert unanswered[0]["op"] == "wordcount"
+    assert unanswered[0]["text"] == "la la la"
+    # Clean shutdown: the marker was present, so not an unclean start.
+    assert j2.stats()["unclean_start"] is False
+    # The replied id dedups byte-identically; the open one does not.
+    assert j2.lookup_reply("a") == {"ok": True, "label": "Positive"}
+    assert j2.lookup_reply("b") is None
+    stats = j2.stats()
+    assert stats["replayed"] == 1
+    assert stats["deduped"] == 1  # the lookup_reply hit above
+    assert stats["open_requests"] == 1
+    j2.close()
+
+
+def test_non_string_ids_and_slo_fields_round_trip(tmp_path):
+    """Wire ids are arbitrary JSON values; SLO fields journal as null so
+    a replay re-submits with the server's own defaults."""
+    d = str(tmp_path / "wal")
+    j = RequestJournal(d)
+    j.recover()
+    j.record_admitted(7, "generate", "verse one", deadline_ms=None,
+                      meta={"max_new_tokens": 4})
+    j.record_admitted([1, "x"], "sentiment", "chorus")
+    j.record_replied(7, {"ok": True, "text": "verse one two"})
+    j.close()
+    j2 = RequestJournal(d)
+    unanswered = j2.recover()
+    assert [r["id"] for r in unanswered] == [[1, "x"]]
+    assert j2.lookup_reply(7) == {"ok": True, "text": "verse one two"}
+    record = next(r for r in [unanswered[0]])
+    assert record["deadline_ms"] is None
+    j2.close()
+
+
+def test_journal_used_before_recover_is_a_usage_error(tmp_path):
+    j = RequestJournal(str(tmp_path / "wal"))
+    with pytest.raises(RuntimeError, match="recover"):
+        j.record_admitted("a", "sentiment", "x")
+
+
+# ---------------------------------------------------- corruption tolerance
+
+
+def test_torn_tail_is_counted_skipped_and_never_crashes(tmp_path):
+    """A crash mid-``write`` leaves a partial frame; replay abandons the
+    tail, keeps everything before it, and reports the damage."""
+    d = str(tmp_path / "wal")
+    j = RequestJournal(d, sync_every=1)
+    j.recover()
+    j.record_admitted("a", "sentiment", "first")
+    j.record_replied("a", {"ok": True, "label": "Positive"})
+    j.record_admitted("b", "sentiment", "second")
+    # Simulate SIGKILL: abandon the handle (no close/compact/marker) and
+    # tear the tail with a partial header.
+    with open(_active_segment(d), "ab") as fh:
+        fh.write(b"\xff\xff\xff")
+    j2 = RequestJournal(d)
+    unanswered = j2.recover()
+    stats = j2.stats()
+    assert stats["unclean_start"] is True
+    assert stats["corrupt_truncated"] >= 1
+    assert [r["id"] for r in unanswered] == ["b"]  # survived the tear
+    assert j2.lookup_reply("a") == {"ok": True, "label": "Positive"}
+    j2.close()
+
+
+def test_crc_flip_abandons_tail_but_keeps_prefix(tmp_path):
+    d = str(tmp_path / "wal")
+    j = RequestJournal(d, sync_every=1)
+    j.recover()
+    j.record_admitted("keep", "sentiment", "intact record")
+    j.record_admitted("rot", "sentiment", "this one rots")
+    path = _active_segment(d)
+    with open(path, "rb") as fh:
+        data = bytearray(fh.read())
+    data[-1] ^= 0x5A  # bit-rot inside the LAST record's payload
+    with open(path, "wb") as fh:
+        fh.write(data)
+    j2 = RequestJournal(d)
+    unanswered = j2.recover()
+    assert [r["id"] for r in unanswered] == ["keep"]
+    assert j2.stats()["corrupt_truncated"] == 1
+    j2.close()
+
+
+def test_length_past_eof_is_corruption_not_overread(tmp_path):
+    d = str(tmp_path / "wal")
+    j = RequestJournal(d, sync_every=1)
+    j.recover()
+    j.record_admitted("ok", "sentiment", "fine")
+    with open(_active_segment(d), "ab") as fh:
+        fh.write(_HEADER.pack(10_000, zlib.crc32(b"x")) + b"short")
+    j2 = RequestJournal(d)
+    assert [r["id"] for r in j2.recover()] == ["ok"]
+    assert j2.stats()["corrupt_truncated"] == 1
+    j2.close()
+
+
+# --------------------------------------------------- rotation + compaction
+
+
+def test_rotation_compacts_history_to_live_state(tmp_path):
+    """Sealed segments collapse into one fresh segment holding only live
+    state — the directory stays small and restart replay stays O(live),
+    not O(all traffic)."""
+    d = str(tmp_path / "wal")
+    j = RequestJournal(d, sync_every=4, rotate_bytes=4096, dedup_limit=8)
+    j.recover()
+    filler = "x" * 200
+    for i in range(64):
+        j.record_admitted(i, "sentiment", f"{filler} {i}")
+        j.record_replied(i, {"ok": True, "label": "Positive", "i": i})
+    j.record_admitted("open", "sentiment", "still in flight")
+    stats = j.stats()
+    assert stats["rotations"] >= 1
+    assert stats["compactions"] >= 1
+    assert len(_segments(d)) <= 2  # compacted history + active segment
+    j.close()
+
+    j2 = RequestJournal(d)
+    unanswered = j2.recover()
+    assert [r["id"] for r in unanswered] == ["open"]
+    # Dedup window survives compaction (bounded by dedup_limit).
+    assert j2.lookup_reply(63) == {"ok": True, "label": "Positive",
+                                   "i": 63}
+    assert j2.stats()["dedup_index"] <= 8
+    j2.close()
+
+
+def test_dedup_index_is_lru_bounded(tmp_path):
+    d = str(tmp_path / "wal")
+    j = RequestJournal(d, dedup_limit=4)
+    j.recover()
+    for i in range(6):
+        j.record_admitted(i, "sentiment", f"t{i}")
+        j.record_replied(i, {"ok": True, "i": i})
+    assert j.lookup_reply(0) is None  # evicted: recompute (pure op) is
+    assert j.lookup_reply(1) is None  # correct, just not free
+    assert j.lookup_reply(5) == {"ok": True, "i": 5}
+    assert j.stats()["dedup_index"] <= 4
+    j.close()
+
+
+# ------------------------------------------------------------ group commit
+
+
+def test_group_commit_defers_fsync_until_sync_barrier(tmp_path):
+    d = str(tmp_path / "wal")
+    j = RequestJournal(d, sync_every=100)
+    j.recover()
+    syncs0 = j.stats()["syncs"]
+    for i in range(3):
+        j.record_admitted(i, "sentiment", f"t{i}")
+        j.record_replied(i, {"ok": True, "i": i}, sync=False)
+    assert j.stats()["syncs"] == syncs0  # nothing forced a barrier yet
+    j.sync()
+    assert j.stats()["syncs"] == syncs0 + 1  # the whole batch, one fsync
+    # The batch is durable: a crash-and-restart sees every reply.
+    j2 = RequestJournal(d)
+    j2.recover()
+    assert all(j2.lookup_reply(i) is not None for i in range(3))
+    j2.close()
+    j.close()
+
+
+def test_unclean_marker_lifecycle(tmp_path):
+    d = str(tmp_path / "wal")
+    j = RequestJournal(d)
+    j.recover()
+    j.record_admitted("a", "sentiment", "x")
+    j.close()
+    assert os.path.exists(os.path.join(d, "clean"))
+    j2 = RequestJournal(d)
+    j2.recover()  # consumes the marker: this process's crash is visible
+    assert j2.stats()["unclean_start"] is False
+    assert not os.path.exists(os.path.join(d, "clean"))
+    # Abandon j2 (SIGKILL stand-in): next boot must see an unclean start.
+    j3 = RequestJournal(d)
+    j3.recover()
+    assert j3.stats()["unclean_start"] is True
+    j3.close()
+
+
+# --------------------------------------- atomic_write durability regression
+
+
+def test_atomic_write_durable_fsyncs_before_rename(tmp_path, monkeypatch):
+    """The write-barrier regression: ``durable=True`` must fsync the
+    staged file BEFORE the rename publishes it (data reaches the platter
+    before the name does) and the directory after."""
+    events = []
+    real_fsync, real_replace = os.fsync, os.replace
+    monkeypatch.setattr(
+        os, "fsync",
+        lambda fd: (events.append("fsync"), real_fsync(fd))[1],
+    )
+    monkeypatch.setattr(
+        os, "replace",
+        lambda a, b: (events.append("replace"), real_replace(a, b))[1],
+    )
+    target = str(tmp_path / "artifact.bin")
+    with atomic_write(target, mode="wb", encoding=None, durable=True) as fh:
+        fh.write(b"payload")
+    assert events.index("fsync") < events.index("replace")
+    assert "fsync" in events[events.index("replace"):]  # dir fsync after
+    with open(target, "rb") as fh:
+        assert fh.read() == b"payload"
+
+
+def test_atomic_write_default_stays_cheap(tmp_path, monkeypatch):
+    """Bulk artifact writers keep the historical fast path: no fsync
+    unless ``durable=True`` or ``$MUSICAAL_ATOMIC_FSYNC=1``."""
+    monkeypatch.delenv("MUSICAAL_ATOMIC_FSYNC", raising=False)
+    calls = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (calls.append(fd), real_fsync(fd))[1]
+    )
+    with atomic_write(str(tmp_path / "fast.txt")) as fh:
+        fh.write("cheap")
+    assert calls == []
+    monkeypatch.setenv("MUSICAAL_ATOMIC_FSYNC", "1")
+    with atomic_write(str(tmp_path / "paranoid.txt")) as fh:
+        fh.write("durable")
+    assert len(calls) >= 1
+
+
+# ----------------------------------------------- O(1) resume (tentpole b)
+
+
+@pytest.fixture(scope="module")
+def clf():
+    from music_analyst_tpu.models.llama import (
+        LlamaConfig,
+        LlamaZeroShotClassifier,
+    )
+
+    return LlamaZeroShotClassifier(
+        config=LlamaConfig.tiny(), max_prompt_len=64
+    )
+
+
+LOW_PROMPTS = [
+    "midnight train ballad of the patient tenant",
+    "thunder rolls over the empty stage",
+]
+HIGH_PROMPT = "gold tenant single drops mid decode"
+
+
+def _scheduler(clf, **kwargs):
+    from music_analyst_tpu.serving.decode_loop import ContinuousScheduler
+
+    kwargs.setdefault("prefill_chunk", 16)
+    kwargs.setdefault("prompt_region", 64)
+    kwargs.setdefault("max_new_tokens", 8)
+    kwargs.setdefault("max_queue", 16)
+    return ContinuousScheduler(clf, **kwargs)
+
+
+def _force_preemption(sched):
+    """Submit a low-priority decode, let it reach mid-decode, then land a
+    gold admit whose 1 ms TTFT target arms the slot steal."""
+    low = [
+        sched.submit(i, p, priority=1, deadline_ms=60_000.0)
+        for i, p in enumerate(LOW_PROMPTS)
+    ]
+    for _ in range(64):
+        sched._tick()
+        if any(s is not None and s.active and s.steps > 0
+               for s in sched._slots):
+            break
+    high = sched.submit("gold", HIGH_PROMPT, priority=5,
+                        deadline_ms=60_000.0)
+    for _ in range(64):
+        if sched.stats()["preemptions"] >= 1:
+            break
+        sched._tick()
+    return low, high
+
+
+@pytest.mark.parametrize("page_size", [None, 0], ids=["paged", "slots"])
+def test_preempt_resume_is_o1_and_byte_identical(clf, page_size):
+    """The resumed victim re-enters decode from its checkpoint — zero
+    prefill chunks re-run (``resume_chunks_skipped`` counts the skips),
+    greedy tokens byte-identical to the undisturbed scan, zero retraces,
+    on both KV backends."""
+    static = clf.generate_batch(LOW_PROMPTS + [HIGH_PROMPT],
+                                max_new_tokens=8)
+    # Oversubscribed page pool (paged backend): the checkpoint pins the
+    # victim's pages, so without headroom the incoming gold admit's
+    # pressure valve would release it and degrade resume to re-prefill.
+    kwargs = dict(n_slots=2, ttft_slo_ms=1.0, kv_pages=24)
+    if page_size is not None:
+        kwargs["page_size"] = page_size
+    sched = _scheduler(clf, **kwargs)
+    sched.warmup()
+    variants_before = sched.runtime.compiled_variants()
+    low, high = _force_preemption(sched)
+    sched.run_until_idle()
+    for req, want in zip(low, static[:len(LOW_PROMPTS)]):
+        assert req.response["ok"], req.response
+        assert req.response["text"] == want
+    assert high.response["ok"] and high.response["text"] == static[-1]
+    stats = sched.stats()
+    assert stats["preemptions"] >= 1
+    assert stats["resumed_o1"] >= 1
+    assert stats["resume_chunks_skipped"] >= 1  # O(1), not re-prefill
+    assert sched.runtime.compiled_variants() == variants_before
+
+
+def test_drain_answers_preempted_victim_awaiting_resume(clf):
+    """The SIGTERM × preemption seam (satellite 4): a drain that lands
+    while the preempted victim is requeued awaiting its checkpoint
+    resume must still answer every admitted request — drain means
+    'finish the backlog', and the backlog includes the victim."""
+    static = clf.generate_batch(LOW_PROMPTS + [HIGH_PROMPT],
+                                max_new_tokens=8)
+    sched = _scheduler(clf, n_slots=1, ttft_slo_ms=1.0, kv_pages=24)
+    sched.warmup()
+    low, high = _force_preemption(sched)
+    assert sched.stats()["preemptions"] >= 1
+    assert not all(r.done for r in low)  # the victim is still waiting
+    sched.drain()  # inline: no loop thread was started
+    for req, want in zip(low, static[:len(LOW_PROMPTS)]):
+        assert req.response["ok"], req.response
+        assert req.response["text"] == want
+    assert high.response["ok"] and high.response["text"] == static[-1]
+    assert sched.stats()["resumed_o1"] >= 1
+
+
+# ----------------------------------------------------- crash drill (wire)
+
+
+def test_crash_drill_sigkill_accounts_and_dedups(tmp_path):
+    """One cheap seam of the full subprocess drill (the ``crash`` bench
+    suite runs all four): SIGKILL a journaled mock server post-admit,
+    restart on the same journal dir, re-send everything — 100%
+    accounting, zero duplicate computes, unclean stamped."""
+    from benchmarks.crash import _MOCK_ARGS, _mock_trace, run_drill
+
+    row = run_drill(
+        "post_admit", "serve.admit:crash@3", str(tmp_path),
+        model_args=_MOCK_ARGS, trace=_mock_trace(8, seed=23),
+    )
+    assert row["killed_by_sigkill"] is True
+    assert row["recovered_exit_ok"] is True
+    assert row["all_accounted"] is True
+    assert row["loadgen_silent_drops"] == 0
+    assert row["duplicates_deduped"] is True
+    assert row["unclean_stamped"] is True
+    assert row["journal"]["unclean_start"] is True

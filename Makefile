@@ -91,7 +91,7 @@ smoke:
 		tests/test_wq_store.py tests/test_serving.py \
 		tests/test_resilience.py tests/test_continuous.py \
 		tests/test_kv_pages.py tests/test_router.py \
-		tests/test_journal.py -q
+		tests/test_journal.py tests/test_speculative.py -q
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= MUSICAAL_BENCH_SMOKE=1 \
 		$(PY) bench.py --baseline --attempts 1 --deadline 240 \
 		| $(PY) -c "import json,sys; \
@@ -220,6 +220,39 @@ print('smoke ok:', payload['metric'], payload['value'])"
 	      pc['tokens_shared'], 'token(s) shared')" \
 		"$$pctmp/replies.ndjson" "$$pctmp/run_manifest.json" || \
 		{ echo "prefix-cache self-check failed"; exit 1; }
+	# speculation self-check: one long repetitive generate prompt through
+	# the stdio server with and without draft-and-verify (--speculate-k)
+	# — the replies must be byte-identical (speculation may never change
+	# output bytes), and the speculative run's manifest must show verify
+	# dispatches that netted more than one committed token each once the
+	# stream entered its cycle (the whole point of drafting).
+	spectmp=$$(mktemp -d) && trap 'rm -rf "$$spectmp"' EXIT && \
+	for arm in plain spec; do \
+		if [ $$arm = spec ]; then sk=4; else sk=0; fi; \
+		printf '%s\n' \
+			'{"id":"k1","op":"generate","text":"la la la la la la","max_new_tokens":96}' | \
+		env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+			$(PY) -m music_analyst_tpu serve --stdio --model llama-tiny --quiet \
+			--slots 2 --prefill-chunk 32 --max-new-tokens 96 --speculate-k $$sk \
+			--max-batch 2 --max-wait-ms 2 --telemetry-dir "$$spectmp/$$arm" \
+			> "$$spectmp/$$arm.ndjson" || \
+			{ echo "speculation $$arm run failed"; exit 1; }; \
+	done && \
+	$(PY) -c "import json,sys; \
+	plain=[json.loads(l) for l in open(sys.argv[1]) if l.strip()]; \
+	spec=[json.loads(l) for l in open(sys.argv[2]) if l.strip()]; \
+	assert [r['text'] for r in plain]==[r['text'] for r in spec], \
+	    'speculation changed output bytes'; \
+	sp=json.load(open(sys.argv[3]))['serving']['decode']['speculation']; \
+	assert sp['enabled'] and sp['k']==4, sp; \
+	assert sp['dispatches']>=1 and sp['fallbacks']==0, sp; \
+	assert sp['accepted_tokens_per_dispatch']>1.0, sp; \
+	print('speculation self-check ok:', sp['dispatches'], 'dispatch(es),', \
+	      sp['accepted_tokens_per_dispatch'], 'tok/dispatch,', \
+	      sp['acceptance_rate'], 'acceptance')" \
+		"$$spectmp/plain.ndjson" "$$spectmp/spec.ndjson" \
+		"$$spectmp/spec/run_manifest.json" || \
+		{ echo "speculation self-check failed"; exit 1; }
 	# router self-check (body in ROUTER_SELFCHECK above): 2 replicas,
 	# 8 requests, SIGKILL one mid-load — zero admitted requests lost,
 	# health transition in the manifest's serving.router section.

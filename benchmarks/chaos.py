@@ -291,6 +291,69 @@ def _prefix_lookup_scenario(n_requests: int) -> dict:
     }
 
 
+def _spec_draft_scenario(n_requests: int) -> dict:
+    """Injected drafter fault (site ``spec.draft``): every faulted tick
+    degrades to plain non-speculative decode before any draft is built —
+    the generated bytes must match the clean speculative run exactly
+    (fewer tokens per dispatch, never a wrong one), and the degradation
+    is visible as ``speculation.fallbacks``."""
+    from music_analyst_tpu.models.llama import (
+        LlamaConfig,
+        LlamaZeroShotClassifier,
+    )
+    from music_analyst_tpu.resilience import configure_faults, fault_stats
+    from music_analyst_tpu.serving.decode_loop import ContinuousScheduler
+
+    clf = LlamaZeroShotClassifier(
+        config=LlamaConfig.tiny(), max_prompt_len=64
+    )
+    sched = ContinuousScheduler(
+        clf, n_slots=2, prefill_chunk=16, prompt_region=64,
+        max_new_tokens=24, max_queue=n_requests + 1, speculate_k=4,
+    )
+    sched.warmup()
+
+    def _texts(tag: str):
+        reqs = [
+            sched.submit(f"{tag}-{i}", f"spec chaos la la la lyric {i}",
+                         max_new_tokens=24)
+            for i in range(n_requests)
+        ]
+        sched.run_until_idle()
+        out = []
+        for req in reqs:
+            resp = req.response or {}
+            if not resp.get("ok"):
+                raise RuntimeError(f"generate {req.id} failed: "
+                                   f"{resp.get('error')}")
+            out.append(resp["text"])
+        return out
+
+    start = time.perf_counter()
+    clean = _texts("clean")
+    spec_before = sched.stats()["speculation"]
+    configure_faults("spec.draft:error@1+")
+    try:
+        faulted = _texts("faulted")
+        trips = fault_stats()["spec.draft"]["trips"]
+    finally:
+        configure_faults(None)
+    elapsed = time.perf_counter() - start
+    spec = sched.stats()["speculation"]
+    return {
+        "scenario": "spec_draft_fault",
+        "spec": "spec.draft:error@1+",
+        "requests": n_requests,
+        "bytes_identical": faulted == clean,
+        "spec_dispatches_clean": spec_before["dispatches"],
+        "spec_active_clean": spec_before["dispatches"] > 0,
+        "fallbacks": spec["fallbacks"],
+        "trips": trips,
+        "all_fell_back": spec["fallbacks"] == trips and trips > 0,
+        "wall_s": round(elapsed, 4),
+    }
+
+
 def _journal_scenario() -> dict:
     """Faulted appends + a torn segment tail (site ``journal.append``):
     the server-side append failure is absorbed (the request still
@@ -515,6 +578,15 @@ def run() -> dict:
             file=sys.stderr,
         )
 
+        spec_draft = _spec_draft_scenario(4 if smoke() else 16)
+        print(
+            f"[chaos] spec_draft: identical="
+            f"{spec_draft['bytes_identical']} "
+            f"fallbacks={spec_draft['fallbacks']} "
+            f"wall={spec_draft['wall_s']:.3f}s",
+            file=sys.stderr,
+        )
+
         preempt = _preempt_scenario()
         print(
             f"[chaos] preempt_fault: identical="
@@ -545,17 +617,20 @@ def run() -> dict:
         "decode": decode,
         "router": router,
         "prefix_lookup": prefix,
+        "spec_draft": spec_draft,
         "preempt_fault": preempt,
         "journal_append": journal_wal,
         "all_identical": all(
             s["bytes_identical"] for s in scenarios
-        ) and prefix["bytes_identical"] and preempt["bytes_identical"],
+        ) and prefix["bytes_identical"] and spec_draft["bytes_identical"]
+        and preempt["bytes_identical"],
         "all_recovered": all(
             s["trips"] > 0
             and (s["degraded"] if s["expect_degraded"] else True)
             for s in scenarios
         ) and serving["all_answered"] and decode["all_answered"]
         and router["all_answered"] and prefix["all_fell_back"]
+        and spec_draft["all_fell_back"]
         and preempt["preempt_faults"] > 0
         and preempt["preemptions_faulted"] == 0
         and journal_wal["degraded_to_recompute"],

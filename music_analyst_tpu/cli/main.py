@@ -334,6 +334,13 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                    help="Physical KV pages in the device pool (>= slots; "
                         "0 sizes it to slots*pages_per_slot; default "
                         "$MUSICAAL_SERVE_KV_PAGES or 0)")
+    p.add_argument("--speculate-k", type=int, default=None,
+                   help="Draft tokens per slot per speculative decode "
+                        "dispatch (prompt-lookup self-drafting; the "
+                        "verify program commits the longest accepted "
+                        "prefix + 1 correction token, byte-identical to "
+                        "plain decode; 0 disables; default "
+                        "$MUSICAAL_SERVE_SPECULATE_K or 0)")
     p.add_argument("--replicas", type=int, default=None,
                    help="Worker server processes behind the replica "
                         "router (join-shortest-queue dispatch, "
@@ -643,6 +650,7 @@ def _dispatch(parser: argparse.ArgumentParser,
                 max_new_tokens=args.max_new_tokens,
                 page_size=args.page_size,
                 kv_pages=args.kv_pages,
+                speculate_k=args.speculate_k,
                 tp=args.tp,
                 ttft_slo_ms=args.ttft_slo_ms,
                 tpot_slo_ms=args.tpot_slo_ms,

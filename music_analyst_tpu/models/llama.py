@@ -1008,6 +1008,7 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         page_size: Optional[int] = None,
         kv_pages: Optional[int] = None,
         prefix_cache: bool = True,
+        speculate_k: Optional[int] = None,
     ) -> List[str]:
         """Greedy generation via the continuous slot runtime, synchronously.
 
@@ -1024,7 +1025,10 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         zero-shot template head, repeat songs — skip the shared prefill
         chunks and share physical pages.  ``page_size=0`` pins the
         monolithic slot cache; ``prefix_cache=False`` pages without
-        sharing.  All routes emit byte-identical tokens.
+        sharing.  ``speculate_k > 0`` turns on draft-and-verify
+        speculative decoding (see ``serving/decode_loop.py``) — fewer
+        dispatches on self-similar completions.  All routes emit
+        byte-identical tokens.
         """
         from music_analyst_tpu.serving.decode_loop import ContinuousScheduler
         from music_analyst_tpu.utils.shapes import round_pow2
@@ -1048,7 +1052,7 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         chunk = min(int(prefill_chunk), region)
         cap = max(1, max(budgets))
         key = (n_slots, chunk, region, cap, int(decode_span),
-               page_size, kv_pages, bool(prefix_cache))
+               page_size, kv_pages, bool(prefix_cache), speculate_k)
         sched = self._slot_schedulers.get(key)
         if sched is None:
             sched = ContinuousScheduler(
@@ -1062,6 +1066,7 @@ class LlamaZeroShotClassifier(ClassifierBackend):
                 page_size=page_size,
                 kv_pages=kv_pages,
                 prefix_cache=prefix_cache,
+                speculate_k=speculate_k,
             )
             self._slot_schedulers[key] = sched
         reqs = [

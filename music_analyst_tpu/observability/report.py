@@ -308,6 +308,7 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     resilience_sites: Dict[str, Dict[str, int]] = {}
     degraded_runs: List[Dict[str, Any]] = []
     router_fleet: List[Dict[str, Any]] = []
+    speculation_runs: List[Dict[str, Any]] = []
 
     def _site(site: str) -> Dict[str, int]:
         return resilience_sites.setdefault(
@@ -398,6 +399,47 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                     for name, snap in (router.get("replicas") or {}).items()
                 },
             })
+        # Speculative decoding: per-run acceptance digest from the
+        # manifest's serving.decode.speculation section (decode_loop
+        # stats()), rolled up into cross-run quantiles below.
+        spec = ((rec.get("serving") or {}).get("decode") or {}).get(
+            "speculation"
+        ) or {}
+        if spec.get("enabled"):
+            speculation_runs.append({
+                "label": rec["label"],
+                "k": spec.get("k"),
+                "dispatches": spec.get("dispatches"),
+                "plain_ticks": spec.get("plain_ticks"),
+                "fallbacks": spec.get("fallbacks"),
+                "acceptance_rate": spec.get("acceptance_rate"),
+                "accepted_tokens_per_dispatch": spec.get(
+                    "accepted_tokens_per_dispatch"
+                ),
+            })
+
+    def _quantiles(values: List[Any]) -> Optional[Dict[str, Any]]:
+        vals = sorted(
+            float(v) for v in values if isinstance(v, (int, float))
+        )
+        if not vals:
+            return None
+
+        def q(p: float) -> float:
+            return vals[min(len(vals) - 1, int(round(p * (len(vals) - 1))))]
+
+        return {"n": len(vals), "p50": q(0.5), "p95": q(0.95),
+                "max": vals[-1]}
+
+    speculation = {
+        "runs": speculation_runs,
+        "acceptance_rate": _quantiles(
+            [r["acceptance_rate"] for r in speculation_runs]
+        ),
+        "accepted_tokens_per_dispatch": _quantiles(
+            [r["accepted_tokens_per_dispatch"] for r in speculation_runs]
+        ),
+    }
     newest = records[-1] if records else None
     return {
         "schema": 1,
@@ -414,6 +456,7 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "resilience": dict(sorted(resilience_sites.items())),
         "degraded_runs": degraded_runs,
         "router_fleet": router_fleet,
+        "speculation": speculation,
         "newest": {
             "label": newest["label"],
             "ok": newest["ok"],
@@ -491,6 +534,35 @@ def render_report(report: Dict[str, Any]) -> List[str]:
                 lines.append(
                     f"    {name}: {snap['dispatched']} / "
                     f"{snap['requeues']} / {snap['health']}"
+                )
+    speculation = report.get("speculation") or {}
+    if speculation.get("runs"):
+        lines.append(
+            "speculative decoding (k / tok-per-dispatch / acceptance / "
+            "fallbacks):"
+        )
+
+        def _num(value: Any) -> str:
+            return (f"{value:.2f}"
+                    if isinstance(value, (int, float)) else "-")
+
+        for run in speculation["runs"]:
+            lines.append(
+                f"  {run['label']}: k={run['k']}, "
+                f"{_num(run['accepted_tokens_per_dispatch'])} / "
+                f"{_num(run['acceptance_rate'])} / "
+                f"{run['fallbacks'] or 0}"
+            )
+        for key, title in (
+            ("acceptance_rate", "acceptance rate"),
+            ("accepted_tokens_per_dispatch", "accepted tokens/dispatch"),
+        ):
+            quants = speculation.get(key)
+            if quants:
+                lines.append(
+                    f"  {title} across {quants['n']} run(s): "
+                    f"p50={_num(quants['p50'])} p95={_num(quants['p95'])} "
+                    f"max={_num(quants['max'])}"
                 )
     for run in report.get("degraded_runs") or []:
         lines.append(

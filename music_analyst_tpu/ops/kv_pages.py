@@ -10,7 +10,7 @@ an int32 page table, so two sequences with a common token prefix can map
 the same physical pages and a prefix hit turns most of chunked prefill
 into a page-table update plus a short suffix prefill.
 
-Device half (this file, compiled): **four fixed-shape programs** via
+Device half (this file, compiled): **five fixed-shape programs** via
 :func:`profiled_jit` — the zero-retrace discipline of ``kv_slots`` with
 the page table as a traced operand, so the programs never retrace as
 pages are shared, copied, and recycled:
@@ -25,6 +25,10 @@ pages are shared, copied, and recycled:
   ``[n_slots, pages_per_slot]`` table, run the identical ``decode_span``
   scan, scatter back only each slot's *decode* pages (never below
   ``prompt_region``, so shared prompt pages are never written by decode).
+* **paged verify block** — score a ``[n_slots, K]`` drafted token block
+  (speculative decoding) in one dispatch over the gathered views,
+  scattering back decode pages only — the paged twin of
+  ``slots.verify``.
 * **page free** — zero a mask of physical pages (failure-path hard
   isolation, the paged analogue of ``slots.free``).
 * **page copy** — one page ``src → dst`` (copy-on-write for the
@@ -142,7 +146,7 @@ class PagePlan:
 
 
 class PagedDecodeRuntime:
-    """Four-program continuous decode over a shared page pool.
+    """Five-program continuous decode over a shared page pool.
 
     Holds no request state — the page table, refcounts, and the radix
     tree live in the host scheduler; this class owns only the compiled
@@ -317,6 +321,69 @@ class PagedDecodeRuntime:
                 new_caches.append(KVCache(keys, values, c.length))
             return new_caches, tokens, steps, done, emitted
 
+        def _verify_block(params, caches, page_table, tokens_blk, prompt_lens,
+                          steps):
+            """Score a ``[n_slots, K]`` drafted block in one dispatch.
+
+            Identical semantics to ``slots.verify`` over the gathered
+            views (column 0 = carry, columns 1.. = drafts; returns the
+            greedy argmax after consuming each prefix — see ``kv_slots``):
+            a teacher-forced scan of the *same* 1-wide step body as
+            ``pages.decode``, because byte-identity demands the logits and
+            written KV rows be bit-identical to plain decode (a K-wide
+            scoring pass reduces in a different order and flips argmax
+            near-ties).  The paged gather in and decode-page scatter out
+            match ``pages.decode``: only slot-local pages >=
+            ``prompt_pages`` are written back, so shared prompt pages are
+            never touched by a rejected draft.  Free slots' rows point at
+            the trash page and receive identical (all-zero-input) writes.
+            """
+            K = tokens_blk.shape[1]
+            steps0 = steps
+            views = [_view(c, page_table, c.length) for c in caches]
+            kv_pos = jnp.arange(total, dtype=jnp.int32)[None, None, None, :]
+
+            def body(carry, tok):
+                views, steps = carry
+                offsets = jnp.minimum(R + steps, total - 1)
+                views_in = [
+                    KVCache(v.keys, v.values, offsets) for v in views
+                ]
+                pos = prompt_lens + steps
+                prompt_part = kv_pos < prompt_lens[:, None, None, None]
+                decode_part = (kv_pos >= R) & (
+                    kv_pos - R <= steps[:, None, None, None]
+                )
+                step_mask = prompt_part | decode_part
+                lg, views_out = self.model.apply(
+                    {"params": params}, tok[:, None], pos[:, None],
+                    step_mask, views_in,
+                )
+                nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+                return (views_out, steps + 1), nxt
+
+            (views, _), preds = jax.lax.scan(
+                body, (views, steps), tokens_blk.T,
+            )
+            preds = preds.T                           # [n, K]
+            # Pages a K-row write starting at an arbitrary in-page offset
+            # can straddle (traced once per K — the scheduler fixes K).
+            n_wp_verify = (K - 1) // P + 2
+            lp0 = (R + steps0) // P
+            n_rows = jnp.arange(plan.n_slots)
+            new_caches = []
+            for c, v in zip(caches, views):
+                vk = _pages(v.keys)       # [n, pps, P, n_kv, D]
+                vv = _pages(v.values)
+                keys, values = c.keys, c.values
+                for j in range(n_wp_verify):
+                    lp = jnp.clip(lp0 + j, plan.prompt_pages, pps - 1)
+                    phys = page_table[n_rows, lp]
+                    keys = keys.at[phys].set(vk[n_rows, lp])
+                    values = values.at[phys].set(vv[n_rows, lp])
+                new_caches.append(KVCache(keys, values, c.length))
+            return new_caches, preds
+
         def _free_pages(caches, page_mask, slot_mask):
             """Zero a mask of physical pages and reset masked slots'
             lengths — the failure-path hard isolation.  Normal completion
@@ -353,6 +420,7 @@ class PagedDecodeRuntime:
 
         self.prefill_chunk = profiled_jit(_prefill_chunk, name="pages.prefill")
         self.decode_step = profiled_jit(_decode_step, name="pages.decode")
+        self.verify_block = profiled_jit(_verify_block, name="pages.verify")
         self.free_pages = profiled_jit(_free_pages, name="pages.free")
         self.copy_page = profiled_jit(_copy_page, name="pages.copy")
 
@@ -391,11 +459,11 @@ class PagedDecodeRuntime:
         return self.plan.page_size * self.kv_token_bytes(dtype)
 
     def compiled_variants(self) -> int:
-        """Total compiled-program count across the four programs — the
+        """Total compiled-program count across the five programs — the
         zero-retrace assertion reads this before/after page-table churn."""
         return sum(
             fn._cache_size()
-            for fn in (self.prefill_chunk, self.decode_step,
+            for fn in (self.prefill_chunk, self.decode_step, self.verify_block,
                        self.free_pages, self.copy_page)
         )
 

@@ -219,6 +219,59 @@ class SlotDecodeRuntime:
             )
             return caches, tokens, steps, done, emitted  # emitted [span, n]
 
+        def _verify_block(params, caches, tokens_blk, prompt_lens, steps):
+            """Score a ``[n_slots, K]`` drafted block in one dispatch.
+
+            Column 0 of ``tokens_blk`` is each slot's pending carry token
+            and columns ``1..K-1`` are host-proposed drafts.  Returns the
+            greedy argmax after consuming ``tokens_blk[:, :t+1]`` for every
+            ``t`` — the host compares drafts against these predictions to
+            find the longest accepted prefix (``serving/decode_loop.py``).
+
+            The block is executed as a teacher-forced scan of the *same*
+            1-wide step body as ``_decode_step`` (drafted tokens in place
+            of argmax feedback).  Byte-identity demands this: a K-wide
+            parallel scoring pass reduces its attention and KV projections
+            in a different summation order, and the last-bit bf16/fp32
+            differences in the written KV rows (and the logits) flip
+            greedy argmax near-ties — observed on CPU with tiny models.
+            Scanning keeps every logit and every committed KV row
+            bit-identical to plain decode while still amortising K tokens
+            into ONE dispatch (one host round trip, one program).
+
+            Rejected-suffix rows are written but never read: each step's
+            mask exposes rows ``<= R + steps + t`` only, and the next
+            dispatch — verify or plain — starts at most ``K-1`` rows back
+            and overwrites them before exposing them.  Host state
+            (budgets, EOS latch, active gating) stays host-side;
+            non-participating slots' writes land in their own dead tail.
+            """
+            kv_pos = jnp.arange(total, dtype=jnp.int32)[None, None, None, :]
+
+            def body(carry, tok):
+                caches, steps = carry
+                offsets = jnp.minimum(R + steps, total - 1)
+                caches_in = [
+                    KVCache(c.keys, c.values, offsets) for c in caches
+                ]
+                pos = prompt_lens + steps                 # [n_slots]
+                prompt_part = kv_pos < prompt_lens[:, None, None, None]
+                decode_part = (kv_pos >= R) & (
+                    kv_pos - R <= steps[:, None, None, None]
+                )
+                step_mask = prompt_part | decode_part
+                lg, caches_out = self.model.apply(
+                    {"params": params}, tok[:, None], pos[:, None],
+                    step_mask, caches_in,
+                )
+                nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+                return (caches_out, steps + 1), nxt
+
+            (caches, _), preds = jax.lax.scan(
+                body, (caches, steps), tokens_blk.T,
+            )
+            return caches, preds.T                        # [n, K]
+
         def _snapshot_slot(caches, slot):
             """Copy one slot's KV rows (every layer) and its write offset
             into stand-alone device buffers — the checkpoint half of O(1)
@@ -283,6 +336,7 @@ class SlotDecodeRuntime:
 
         self.prefill_chunk = profiled_jit(_prefill_chunk, name="slots.prefill")
         self.decode_step = profiled_jit(_decode_step, name="slots.decode")
+        self.verify_block = profiled_jit(_verify_block, name="slots.verify")
         self.free_slots = profiled_jit(_free_slots, name="slots.free")
         self.snapshot_slot = profiled_jit(_snapshot_slot, name="slots.snapshot")
         self.restore_slot = profiled_jit(_restore_slot, name="slots.restore")
@@ -316,12 +370,12 @@ class SlotDecodeRuntime:
         return caches
 
     def compiled_variants(self) -> int:
-        """Total compiled-program count across the five programs — the
+        """Total compiled-program count across the six programs — the
         zero-retrace assertion reads this before/after a workload."""
         return sum(
             fn._cache_size()
-            for fn in (self.prefill_chunk, self.decode_step, self.free_slots,
-                       self.snapshot_slot, self.restore_slot)
+            for fn in (self.prefill_chunk, self.decode_step, self.verify_block,
+                       self.free_slots, self.snapshot_slot, self.restore_slot)
         )
 
     def prompt_chunks(self, n_tokens: int) -> Sequence[int]:

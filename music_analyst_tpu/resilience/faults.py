@@ -60,6 +60,7 @@ SITES = frozenset(
         "ollama.request",
         "serving.dispatch",
         "decode.step",
+        "spec.draft",
         "checkpoint.load",
         "kv_pages.lookup",
         "router.dispatch",

@@ -68,6 +68,10 @@ DEFAULT_PREFILL_CHUNK = 64
 # auto: n_slots * pages_per_slot, i.e. no oversubscription).
 DEFAULT_PAGE_SIZE = 16
 DEFAULT_KV_PAGES = 0
+# Speculative decoding (serving/decode_loop.py): max draft tokens the
+# host self-drafter proposes per slot per verify dispatch (0 = off,
+# plain one-token-per-step decode).
+DEFAULT_SPECULATE_K = 0
 # Scale-out serving (serving/router.py): replica worker count behind the
 # router, and tensor-parallel width within each worker's decode runtime.
 DEFAULT_REPLICAS = 1
@@ -174,6 +178,16 @@ def resolve_page_size(value: Any = None) -> int:
             )
         return DEFAULT_PAGE_SIZE
     return page
+
+
+def resolve_speculate_k(value: Any = None) -> int:
+    """Max drafted tokens per slot per verify dispatch
+    (``--speculate-k`` / ``$MUSICAAL_SERVE_SPECULATE_K``).  ``0``
+    disables speculation (one greedy token per decode step).  An
+    explicit negative/malformed value raises (usage error); a malformed
+    env value falls back to the default."""
+    return int(_resolve(value, "MUSICAAL_SERVE_SPECULATE_K",
+                        DEFAULT_SPECULATE_K, integer=True, minimum=0))
 
 
 def resolve_replicas(value: Any = None) -> int:

@@ -38,6 +38,33 @@ reservoir quantiles (``serving.ttft_seconds`` / ``serving.tpot_seconds``
 land in the run manifest next to the batcher's latency quantiles, where
 ``telemetry-report`` picks them up).
 
+Speculative decoding (``--speculate-k`` / ``$MUSICAAL_SERVE_SPECULATE_K``,
+0 = off): greedy decode is one device round-trip per ``decode_span``
+tokens, and the round-trip — not compute — is the measured bottleneck
+(PERFORMANCE.md).  With ``k > 0`` the decode tick runs the fixed-shape
+*verify* program instead (``slots.verify`` / ``pages.verify``): a
+host-side self-drafter (prompt-lookup over each slot's prompt + emitted
+tokens — no second model) proposes up to ``k`` tokens per slot, the
+device scores the ``[n_slots, k+1]`` block (carry + drafts) in ONE
+dispatch, and the host commits the longest accepted prefix plus the
+first-mismatch correction token — between 1 and ``k+1`` tokens per slot
+per dispatch, never fewer than plain stepping.  Acceptance is exact:
+a draft commits only when it equals the device argmax under the same
+committed context, and the correction token is itself that argmax, so
+output tokens are byte-identical to non-speculative decode at every
+``k`` (the drafter can only change *when* tokens commit, never *which*).
+A per-slot acceptance-rate EWMA adapts the proposed depth inside the
+fixed ``k+1`` program shape (zero retraces); a draft-fault
+(``spec.draft``) tick degrades to one plain decode dispatch — counted
+in ``speculation.fallbacks``, identical bytes.
+
+In-batch dedup at the admission edge: N concurrently-live ``generate``
+requests with identical (tenant, prompt, budget) occupy ONE slot — the
+first is the primary, later arrivals ride as followers and the settled
+reply (success or failure) fans out to each under its own request id
+(``dedup_folded`` in stats; greedy decode is deterministic, so the
+shared reply is exactly what each would have computed).
+
 SLO enforcement (``serving/slo.py``): the admission queue is a
 :class:`FairQueue` (strict priority classes, per-tenant WFQ) with
 per-tenant token buckets and the batcher's full shed contract
@@ -92,6 +119,7 @@ from music_analyst_tpu.serving.batcher import (
     resolve_prefill_chunk,
     resolve_priority,
     resolve_slots,
+    resolve_speculate_k,
     resolve_tenant_budget,
     resolve_tpot_slo_ms,
     resolve_ttft_slo_ms,
@@ -107,13 +135,66 @@ _TOKEN_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0,
 )
 
+# Accepted tokens per verify dispatch lives in [1, k+1]; upper bins cover
+# the largest draft depths anyone sensibly runs.
+_ACCEPTED_BUCKETS = (1, 2, 3, 4, 5, 6, 8, 10, 12, 16, 24, 32)
+
+# N-gram widths the self-drafter tries, longest first: a longer match is
+# a stronger continuation signal; the unigram floor keeps short cycles
+# (a tiny model latching onto one token) draftable.
+_DRAFT_NGRAMS = (3, 2, 1)
+
+# Speculation pays only when drafts mostly land: a verify dispatch runs
+# k+1 sequential device steps, so at low acceptance it nets barely more
+# than the 1-step plain program at many times the cost.  Below this
+# acceptance-EWMA threshold a slot stops proposing drafts (the tick
+# degrades to plain decode) and instead probes with a single draft token
+# once every _PROBE_EVERY_TICKS ticks, which bounds the cost of
+# speculation on an unpredictable stream while keeping the EWMA able to
+# recover the moment the stream turns repetitive.
+_SPECULATE_EWMA_MIN = 0.6
+_PROBE_EVERY_TICKS = 6
+
+
+def _draft_from_history(hist: List[int], k: int) -> List[int]:
+    """Prompt-lookup self-drafting: propose up to ``k`` continuation
+    tokens for a token stream (prompt + emitted + carry).
+
+    Finds the most recent *earlier* occurrence of the stream's trailing
+    n-gram and proposes the tokens that followed it, then re-matches on
+    the extended stream so a short cycle drafts through the whole block.
+    Pure host-side heuristic: a wrong draft costs device compute (the
+    verify program rejects it), never a wrong token.
+    """
+    out: List[int] = []
+    work = list(hist)
+    while len(out) < k:
+        nxt: Optional[List[int]] = None
+        L = len(work)
+        for n in _DRAFT_NGRAMS:
+            if L <= n:
+                continue
+            gram = work[L - n:]
+            for j in range(L - 1, n - 1, -1):
+                if work[j - n:j] == gram:
+                    nxt = work[j:min(j + k - len(out), L)]
+                    break
+            if nxt:
+                break
+        if not nxt:
+            break
+        out.extend(nxt)
+        work.extend(nxt)
+    return out[:k]
+
 
 class _Slot:
     """Host-side state of one occupied KV slot."""
 
     __slots__ = ("req", "ids", "plen", "next_chunk", "budget", "steps",
                  "tokens", "carry", "done", "active", "t_first",
-                 "pages", "kv_shared", "skipped")
+                 "pages", "kv_shared", "skipped", "hist", "accept_ewma",
+                 "probe")
 
     def __init__(self, req: ServeRequest, ids: np.ndarray, plen: int,
                  budget: int) -> None:
@@ -131,6 +212,12 @@ class _Slot:
         self.pages: Optional[List[int]] = None  # paged: this slot's table row
         self.kv_shared = 0         # paged: tokens served from shared pages
         self.skipped = 0           # paged: prefill chunks skipped by the hit
+        # Speculation: cached drafter stream (prompt + emitted + carry;
+        # None = rebuild) and this slot's acceptance-rate EWMA, which
+        # adapts the proposed draft depth inside the fixed program shape.
+        self.hist: Optional[List[int]] = None
+        self.accept_ewma = 1.0
+        self.probe = 0             # ticks since the EWMA drove depth to 0
 
 
 def _ckpt_key(rid: Any) -> str:
@@ -201,6 +288,7 @@ class ContinuousScheduler:
         tenant_budget: Optional[float] = None,
         priority: Optional[int] = None,
         checkpoint_interval: Optional[int] = None,
+        speculate_k: Optional[int] = None,
     ) -> None:
         self.backend = backend
         self.n_slots = resolve_slots(n_slots)
@@ -239,6 +327,14 @@ class ContinuousScheduler:
                 decode_span=decode_span,
             )
         self.plan = self.runtime.plan
+        # Draft depth: k drafts + the carry make a [n_slots, k+1] verify
+        # block whose KV write must fit the decode region from any
+        # participating step, so k is capped at max_new - 1 (ticks where
+        # a slot is within k steps of max_new fall back to plain
+        # stepping — see _decode_tick).
+        self.speculate_k = min(
+            resolve_speculate_k(speculate_k), max(0, self.plan.max_new - 1)
+        )
         self.caches = self.runtime.init_caches()
         if self.paged:
             plan = self.plan
@@ -286,7 +382,24 @@ class ContinuousScheduler:
             "tpot_slo_misses": 0, "retry_after_ms_last": None,
             "shed_queue_full": 0, "shed_slo_unattainable": 0,
             "shed_tenant_budget": 0, "shed_evicted": 0,
+            "dedup_folded": 0,
         }
+        # Speculation counters (stats()["speculation"] → manifest
+        # ``serving.decode.speculation``).
+        self._spec: Dict[str, Any] = {
+            "dispatches": 0,         # verify dispatches
+            "drafted": 0,            # draft tokens proposed
+            "accepted": 0,           # draft tokens accepted
+            "tokens_committed": 0,   # tokens emitted by verify dispatches
+            "fallbacks": 0,          # draft-fault → plain-decode ticks
+            "plain_ticks": 0,        # tail/fallback plain dispatches at k>0
+        }
+        self._accept_hist = Histogram(_OCCUPANCY_BUCKETS)
+        self._block_hist = Histogram(_ACCEPTED_BUCKETS)
+        # In-batch dedup: live generate primaries by (tenant, text,
+        # budget); guarded by _cond (submit side) — fan-out pops under
+        # the same lock.
+        self._dedup_live: Dict[Any, ServeRequest] = {}
         # Live checkpoints keyed by canonical request id, oldest first.
         # Bounded (LRU release) so abandoned checkpoints can't pin the
         # page pool or hold monolithic KV copies forever.
@@ -384,6 +497,15 @@ class ContinuousScheduler:
                 self.caches, zero,
                 jnp.asarray(min(1, plan.n_pages - 1), jnp.int32),
             )
+            if self.speculate_k > 0:
+                # Verify joins the warmup ladder so the first live
+                # speculative request never compiles.
+                self.caches, _ = self.runtime.verify_block(
+                    self.backend.params, self.caches, jnp.asarray(table),
+                    jnp.zeros((n, self.speculate_k + 1), jnp.int32),
+                    jnp.ones((n,), jnp.int32),
+                    jnp.zeros((n,), jnp.int32),
+                )
             self.caches = self.runtime.free_pages(
                 self.caches,
                 jnp.ones((plan.n_pages + 1,), bool),
@@ -403,6 +525,13 @@ class ContinuousScheduler:
                 jnp.zeros((n,), bool),
                 jnp.zeros((n,), bool),
             )
+            if self.speculate_k > 0:
+                self.caches, _ = self.runtime.verify_block(
+                    self.backend.params, self.caches,
+                    jnp.zeros((n, self.speculate_k + 1), jnp.int32),
+                    jnp.ones((n,), jnp.int32),
+                    jnp.zeros((n,), jnp.int32),
+                )
             self.caches = self.runtime.free_slots(
                 self.caches, jnp.ones((n,), bool)
             )
@@ -424,6 +553,7 @@ class ContinuousScheduler:
             "n_slots": self.plan.n_slots,
             "prefill_chunk": self.plan.prefill_chunk,
             "kv_backend": "paged" if self.paged else "slots",
+            "speculate_k": self.speculate_k,
         }
         if self.paged:
             record.update(
@@ -485,6 +615,28 @@ class ContinuousScheduler:
                     )
                     self._shed(req, "shed_tenant_budget", hint_ms)
                     return req
+            # In-batch dedup at the admission edge: an identical live
+            # generate (same tenant, prompt, and budget) is already
+            # queued or decoding — ride its slot as a follower instead
+            # of occupying another; the settled reply fans out at settle
+            # under each follower's own id.  Checked before capacity: a
+            # fold consumes no queue depth, so it never evicts anyone.
+            if op == "generate":
+                dedup_key = (req.tenant, text, budget)
+                primary = self._dedup_live.get(dedup_key)
+                if primary is not None and not primary.done:
+                    primary.meta.setdefault(
+                        "dedup_followers", []
+                    ).append(req)
+                    with self._stats_lock:
+                        self._stats["admitted"] += 1
+                        self._stats["dedup_folded"] += 1
+                        self._tenant_ledger(req.tenant)["admitted"] += 1
+                    tel.count("serving.decode_admitted")
+                    tel.count("serving.decode_dedup_folded")
+                    return req
+            else:
+                dedup_key = None
             # Deadline check BEFORE capacity: a request the drain
             # estimate already dooms must not evict anyone.
             if req.deadline_ms is not None and req.deadline_ms > 0.0:
@@ -525,6 +677,12 @@ class ContinuousScheduler:
                     retry_after_ms=hint_ms,
                 )
                 self._shed(victim, "shed_evicted", hint_ms)
+                self._fanout_locked(victim)
+            if dedup_key is not None:
+                # Past the shed ladder: this request is the live primary
+                # later identical arrivals fold onto.
+                req.meta["dedup_key"] = dedup_key
+                self._dedup_live[dedup_key] = req
             self._queue.append(req)
             depth = len(self._queue)
             self._cond.notify_all()
@@ -555,6 +713,43 @@ class ContinuousScheduler:
                 self._stats["retry_after_ms_last"] = hint_ms
             self._tenant_ledger(req.tenant)["shed"] += 1
         get_telemetry().count("serving.shed")
+
+    def _fanout(self, req: ServeRequest) -> None:
+        """Fan a settled dedup primary's reply (success OR failure) out to
+        its followers under each follower's own request id, and retire
+        the registry entry.  No-op for requests that never registered."""
+        with self._cond:
+            self._fanout_locked(req)
+
+    def _fanout_locked(self, req: ServeRequest) -> None:
+        """Caller holds ``_cond``."""
+        key = req.meta.pop("dedup_key", None)
+        if key is not None and self._dedup_live.get(key) is req:
+            del self._dedup_live[key]
+        followers = req.meta.pop("dedup_followers", None)
+        if not followers or req.response is None:
+            return
+        ok = bool(req.response.get("ok"))
+        served = 0
+        for f in followers:
+            if f.done:
+                continue
+            payload = dict(req.response)
+            payload["id"] = f.id
+            f.complete(payload)
+            served += 1
+            with self._stats_lock:
+                if ok:
+                    self._stats["completed"] += 1
+                    self._tenant_ledger(f.tenant)["completed"] += 1
+                else:
+                    self._stats["failed"] += 1
+        if served:
+            get_telemetry().count(
+                "serving.decode_completed" if ok
+                else "serving.request_failed",
+                served,
+            )
 
     def _settle_rate(self) -> float:
         """Observed settle throughput (requests/s since construction) —
@@ -681,6 +876,7 @@ class ContinuousScheduler:
                          f"{type(exc).__name__}: {exc}"[:300])
                 self._bump(failed=1)
                 get_telemetry().count("serving.request_failed")
+                self._fanout(req)
                 continue
             slot = _Slot(
                 req, np.asarray(ids, np.int32), plen,
@@ -1075,6 +1271,7 @@ class ContinuousScheduler:
                               f"{type(exc).__name__}: {exc}"[:300])
                 self._bump(failed=1)
                 tel.count("serving.request_failed")
+                self._fanout(slot.req)
                 self._free([idx], zero=True)
                 continue
             self.caches = caches
@@ -1131,14 +1328,205 @@ class ContinuousScheduler:
         )
 
     def _decode_tick(self) -> bool:
-        tel = get_telemetry()
-        n = self.plan.n_slots
         occupied = [
             (i, s) for i, s in enumerate(self._slots)
             if s is not None and s.active
         ]
         if not occupied:
             return False
+        if self.speculate_k > 0:
+            K = self.speculate_k + 1
+            # A verify dispatch writes K KV rows from every participating
+            # slot's step, so a slot within K rows of the decode region's
+            # end (the last k steps of a max_new-budget generation) can't
+            # take the block write without clobbering committed rows —
+            # those rare ticks run the plain program instead, byte-
+            # identical either way.
+            if all(s.steps + K <= self.plan.max_new for _, s in occupied):
+                try:
+                    fault_point("spec.draft", active=len(occupied),
+                                k=self.speculate_k)
+                    drafts = {i: self._draft(s) for i, s in occupied}
+                except Exception:  # noqa: BLE001 — degrade to plain decode
+                    # A broken drafter costs this tick's speedup, never a
+                    # token: the plain program commits the carry exactly
+                    # as non-speculative decode would.
+                    with self._stats_lock:
+                        self._spec["fallbacks"] += 1
+                    get_telemetry().count("serving.spec_fallbacks")
+                else:
+                    if any(drafts.values()):
+                        return self._verify_tick(occupied, drafts)
+                    # Every slot declined to draft (streams currently
+                    # unpredictable): the 1-step plain program commits
+                    # the same carries at a fraction of the k+1-step
+                    # verify cost.
+            with self._stats_lock:
+                self._spec["plain_ticks"] += 1
+        return self._plain_decode_tick(occupied)
+
+    def _draft(self, s: _Slot) -> List[int]:
+        """Propose draft tokens for one slot.
+
+        The per-slot draft cache is the memoized prompt+emitted+carry
+        stream (invalidated by plain-tick commits, extended in place by
+        verify commits); the slot's acceptance EWMA adapts the proposed
+        depth inside the fixed ``k+1`` block shape — fewer drafts for a
+        slot that keeps rejecting, back to full depth as acceptance
+        recovers, zero retraces throughout.
+        """
+        if s.hist is None:
+            s.hist = [int(t) for t in s.ids[:s.plen]]
+            s.hist.extend(s.tokens)
+            s.hist.append(s.carry)
+        if s.accept_ewma < _SPECULATE_EWMA_MIN:
+            # The stream is currently unpredictable: a k+1-step verify
+            # dispatch would net barely more than the 1-step plain
+            # program at k+1 times the device cost.  Proposing nothing
+            # lets the tick degrade to plain decode; a depth-1 probe
+            # every few ticks re-measures the stream so the EWMA can
+            # climb back once it turns repetitive.
+            s.probe += 1
+            if s.probe < _PROBE_EVERY_TICKS:
+                return []
+            s.probe = 0
+            depth = 1
+        else:
+            depth = max(1, min(
+                self.speculate_k,
+                int(round(self.speculate_k * s.accept_ewma)),
+            ))
+        # Tokens past the slot's budget can never commit — don't draft
+        # them (the commit-side clamp would discard them anyway).
+        depth = min(depth, s.budget - s.steps - 1)
+        if depth <= 0:
+            return []
+        return _draft_from_history(s.hist, depth)
+
+    def _device_verify(self, tokens_blk, plens, steps):
+        fault_point("decode.step", phase="verify", k=self.speculate_k)
+        import jax.numpy as jnp
+
+        if self.paged:
+            return self.runtime.verify_block(
+                self.backend.params, self.caches, jnp.asarray(self._table),
+                jnp.asarray(tokens_blk), jnp.asarray(plens),
+                jnp.asarray(steps),
+            )
+        return self.runtime.verify_block(
+            self.backend.params, self.caches,
+            jnp.asarray(tokens_blk), jnp.asarray(plens), jnp.asarray(steps),
+        )
+
+    def _verify_tick(self, occupied, drafts: Dict[int, List[int]]) -> bool:
+        """One speculative decode tick: score every slot's carry+drafts
+        block in a single verify dispatch, commit each slot's longest
+        accepted prefix plus the first-mismatch correction token.
+
+        Acceptance is exact equality against the device argmax under the
+        same committed context, and the correction token is that argmax
+        itself — so every committed token equals what plain stepping
+        would have produced, and every dispatch nets >= 1 token per
+        participating slot (the carry always commits).
+        """
+        tel = get_telemetry()
+        n = self.plan.n_slots
+        K = self.speculate_k + 1
+        tokens_blk = np.zeros((n, K), np.int32)
+        plens = np.zeros(n, np.int32)
+        steps = np.zeros(n, np.int32)
+        for i, s in occupied:
+            tokens_blk[i, 0] = s.carry
+            for j, t in enumerate(drafts.get(i) or ()):
+                tokens_blk[i, 1 + j] = t
+            plens[i] = s.plen
+            steps[i] = s.steps
+        t0 = time.perf_counter()
+        try:
+            with watchdog.watch("decode.dispatch", kind="decode"):
+                caches, preds = self._retry.call(
+                    self._device_verify, tokens_blk, plens, steps,
+                    site="decode.step",
+                )
+            import jax
+
+            preds = jax.device_get(preds)
+        except Exception as exc:  # noqa: BLE001 — the loop must survive
+            detail = f"{type(exc).__name__}: {exc}"[:300]
+            for i, s in occupied:
+                s.req.fail("request_failed", detail)
+                self._fanout(s.req)
+            self._bump(failed=len(occupied))
+            tel.count("serving.request_failed", len(occupied))
+            self._free([i for i, _ in occupied], zero=True)
+            return True
+        decode_s = time.perf_counter() - t0
+        self.caches = caches
+        occ = len(occupied) / n
+        eos = self.runtime.eos_id
+        committed = drafted_total = accepted_total = 0
+        rates: List[float] = []
+        freed: List[int] = []
+        for i, s in occupied:
+            d = drafts.get(i) or []
+            row = preds[i]
+            acc = 0
+            while acc < len(d) and d[acc] == int(row[acc]):
+                acc += 1
+            # Longest accepted prefix + budget freeze: never commit past
+            # the slot's budget, and the carry always commits (>= 1).
+            emit_n = min(acc + 1, s.budget - s.steps)
+            emitted = ([s.carry] + d)[:emit_n]
+            s.tokens.extend(emitted)
+            s.steps += emit_n
+            new_carry = int(row[emit_n - 1])
+            if s.hist is not None:
+                # The cache's tail was the old carry (= emitted[0]):
+                # extend with the rest of the block and the new carry.
+                s.hist.extend(emitted[1:])
+                s.hist.append(new_carry)
+            s.carry = new_carry
+            if d:
+                rate = acc / len(d)
+                s.accept_ewma = 0.8 * s.accept_ewma + 0.2 * rate
+                rates.append(rate)
+                drafted_total += len(d)
+                accepted_total += acc
+            committed += emit_n
+            saw_eos = eos in emitted
+            if saw_eos:
+                s.done = True
+            if saw_eos or s.steps >= s.budget:
+                freed.append(i)
+        with self._stats_lock:
+            self._stats["decode_dispatches"] += 1
+            self._stats["decode_seconds"] += decode_s
+            self._stats["tokens_generated"] += committed
+            self._occupancy.observe(occ)
+            self._spec["dispatches"] += 1
+            self._spec["drafted"] += drafted_total
+            self._spec["accepted"] += accepted_total
+            self._spec["tokens_committed"] += committed
+            for rate in rates:
+                self._accept_hist.observe(rate)
+            self._block_hist.observe(committed / len(occupied))
+        tel.observe("serving.slot_occupancy", occ,
+                    buckets=_OCCUPANCY_BUCKETS)
+        if self.checkpoint_interval > 0:
+            with self._stats_lock:
+                dispatches = self._stats["decode_dispatches"]
+            if dispatches % self.checkpoint_interval == 0:
+                settling = set(freed)
+                for i, s in occupied:
+                    if i not in settling:
+                        self._checkpoint(i, s)
+        for i in freed:
+            self._settle(i, self._slots[i])
+        return True
+
+    def _plain_decode_tick(self, occupied) -> bool:
+        tel = get_telemetry()
+        n = self.plan.n_slots
         tokens = np.zeros(n, np.int32)
         plens = np.zeros(n, np.int32)
         steps = np.zeros(n, np.int32)
@@ -1173,6 +1561,7 @@ class ContinuousScheduler:
             detail = f"{type(exc).__name__}: {exc}"[:300]
             for i, s in occupied:
                 s.req.fail("request_failed", detail)
+                self._fanout(s.req)
             self._bump(failed=len(occupied))
             tel.count("serving.request_failed", len(occupied))
             self._free([i for i, _ in occupied], zero=True)
@@ -1193,6 +1582,7 @@ class ContinuousScheduler:
             s.steps = int(steps_out[i])
             s.carry = int(tok_out[i])
             s.done = bool(done_out[i])
+            s.hist = None  # draft cache is stale once the carry moved
             self._bump(tokens_generated=emitted_n)
             saw_eos = emitted_n > 0 and self.runtime.eos_id in s.tokens[-emitted_n:]
             if saw_eos or s.steps >= s.budget:
@@ -1251,6 +1641,7 @@ class ContinuousScheduler:
         tel.observe("serving.request_seconds", now - slot.req.t_enqueue,
                     buckets=_LATENCY_BUCKETS)
         self._drop_ckpt_for(slot.req)
+        self._fanout(slot.req)
         self._free([idx])
 
     def _free(self, indices: List[int], zero: bool = False) -> None:
@@ -1330,6 +1721,9 @@ class ContinuousScheduler:
             ttft = self._ttft.as_dict()
             tpot = self._tpot.as_dict()
             occ = self._occupancy.as_dict()
+            spec = dict(self._spec)
+            accept_hist = self._accept_hist.as_dict()
+            block_hist = self._block_hist.as_dict()
         with self._cond:
             backlog = len(self._queue)
         active = sum(1 for s in self._slots if s is not None and s.active)
@@ -1362,6 +1756,21 @@ class ContinuousScheduler:
         )
         out["ttft_ewma_ms"] = round(self._ttft_ewma_s * 1000.0, 3)
         out["tpot_ewma_ms"] = round(self._tpot_ewma_s * 1000.0, 3)
+        spec.update(
+            enabled=self.speculate_k > 0,
+            k=self.speculate_k,
+            acceptance_rate=(
+                round(spec["accepted"] / spec["drafted"], 4)
+                if spec["drafted"] else None
+            ),
+            accepted_tokens_per_dispatch=(
+                round(spec["tokens_committed"] / spec["dispatches"], 4)
+                if spec["dispatches"] else None
+            ),
+            acceptance_rate_hist=accept_hist,
+            accepted_tokens_hist=block_hist,
+        )
+        out["speculation"] = spec
         if self.paged:
             plan = self.plan
             with self._stats_lock:

@@ -908,6 +908,7 @@ def _replica_cmd(
     page_size: Optional[int],
     kv_pages: Optional[int],
     warmup: bool,
+    speculate_k: Optional[int] = None,
     ttft_slo_ms: Optional[float] = None,
     tpot_slo_ms: Optional[float] = None,
     tenant_budget: Optional[float] = None,
@@ -933,6 +934,7 @@ def _replica_cmd(
         ("--prefill-chunk", prefill_chunk),
         ("--page-size", page_size),
         ("--kv-pages", kv_pages),
+        ("--speculate-k", speculate_k),
         ("--ttft-slo-ms", ttft_slo_ms),
         ("--tpot-slo-ms", tpot_slo_ms),
         ("--tenant-budget", tenant_budget),
@@ -962,6 +964,7 @@ def spawn_replicas(
     max_new_tokens: int = 16,
     page_size: Optional[int] = None,
     kv_pages: Optional[int] = None,
+    speculate_k: Optional[int] = None,
     warmup: bool = True,
     connect: bool = True,
     ttft_slo_ms: Optional[float] = None,
@@ -996,6 +999,7 @@ def spawn_replicas(
                 socket_path, model, mock, weight_quant, tp, max_batch,
                 max_wait_ms, max_queue, slots, prefill_chunk,
                 max_new_tokens, page_size, kv_pages, warmup,
+                speculate_k=speculate_k,
                 ttft_slo_ms=ttft_slo_ms, tpot_slo_ms=tpot_slo_ms,
                 tenant_budget=tenant_budget, priority=priority,
                 journal_dir=replica_journal,
@@ -1039,6 +1043,7 @@ def run_router(
     max_new_tokens: int = 16,
     page_size: Optional[int] = None,
     kv_pages: Optional[int] = None,
+    speculate_k: Optional[int] = None,
     ttft_slo_ms: Optional[float] = None,
     tpot_slo_ms: Optional[float] = None,
     tenant_budget: Optional[float] = None,
@@ -1071,7 +1076,7 @@ def run_router(
                 max_queue=max_queue, slots=slots,
                 prefill_chunk=prefill_chunk,
                 max_new_tokens=max_new_tokens, page_size=page_size,
-                kv_pages=kv_pages, warmup=warmup,
+                kv_pages=kv_pages, speculate_k=speculate_k, warmup=warmup,
                 ttft_slo_ms=ttft_slo_ms, tpot_slo_ms=tpot_slo_ms,
                 tenant_budget=tenant_budget, priority=priority,
                 journal_dir=journal_base,

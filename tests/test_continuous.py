@@ -299,8 +299,12 @@ def test_decode_stall_classifies_in_report():
 
 
 def test_admission_sheds_queue_full_and_draining(clf):
+    # Distinct texts: identical in-flight generates would fold at the
+    # dedup edge (tests/test_speculative.py) instead of ever queueing.
     sched = _scheduler(clf, n_slots=2, max_queue=2)
-    blocked = [sched.submit(i, "text", max_new_tokens=1) for i in range(3)]
+    blocked = [
+        sched.submit(i, f"text {i}", max_new_tokens=1) for i in range(3)
+    ]
     shed = blocked[2]
     assert shed.done and shed.response["error"]["kind"] == "queue_full"
     sched.run_until_idle()

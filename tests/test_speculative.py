@@ -1,0 +1,363 @@
+"""Speculative decoding (draft-and-verify) inside the fixed decode programs.
+
+Contract families (ISSUE 15):
+
+* **equivalence** — greedy text under speculation is byte-identical to
+  the non-speculative scan at every draft depth, on both KV backends,
+  under shuffled arrival and mixed per-request budgets; EOS-latch and
+  budget-freeze semantics survive accepted blocks.
+* **shapes** — the verify program joins the warmup ladder only when
+  speculation is on; zero retraces across a speculative workload
+  (``compiled_variants`` flat); the proposed depth adapts inside the
+  fixed ``k+1`` block.
+* **resilience** — an injected ``spec.draft`` fault degrades the tick to
+  plain decode with identical bytes and a counted fallback; preemption
+  mid-speculation checkpoints and resumes O(1) with identical bytes.
+* **knobs** — ``--speculate-k`` / ``MUSICAAL_SERVE_SPECULATE_K``
+  resolution: explicit bad values raise, malformed env falls back.
+* **dedup** — identical in-flight generate requests fold to one slot
+  and fan the reply out (``dedup_folded``), each reply under its own id.
+"""
+
+import json
+import random
+
+import pytest
+
+from music_analyst_tpu.serving.batcher import resolve_speculate_k
+
+
+@pytest.fixture(scope="module")
+def clf():
+    from music_analyst_tpu.models.llama import (
+        LlamaConfig,
+        LlamaZeroShotClassifier,
+    )
+
+    return LlamaZeroShotClassifier(
+        config=LlamaConfig.tiny(), max_prompt_len=64
+    )
+
+
+PROMPTS = [
+    "golden sunshine on the river",
+    "rain",
+    "shadows fall across the empty street tonight",
+    "my heart beats a broken drum",
+    "la la la la",
+    "winter wind and summer fire",
+    "ok",
+    "the long road home winds past the silver lake and over the hills",
+]
+
+# Streams that emit EOS well before a 16-token budget under the tiny
+# config at seed 0 — the EOS-latch × accepted-block interaction.
+EOS_PROMPTS = ["la la la", "hey hey", "sun", "dance dance"]
+
+
+def _scheduler(clf, **kwargs):
+    from music_analyst_tpu.serving.decode_loop import ContinuousScheduler
+
+    kwargs.setdefault("prefill_chunk", 16)
+    kwargs.setdefault("prompt_region", 64)
+    kwargs.setdefault("max_new_tokens", 16)
+    kwargs.setdefault("max_queue", 64)
+    return ContinuousScheduler(clf, **kwargs)
+
+
+def _run(sched, prompts, budgets=None, order=None):
+    budgets = budgets or [sched.plan.max_new] * len(prompts)
+    order = order if order is not None else range(len(prompts))
+    reqs = {}
+    for i in order:
+        reqs[i] = sched.submit(i, prompts[i], max_new_tokens=budgets[i])
+    sched.run_until_idle()
+    out = []
+    for i in range(len(prompts)):
+        resp = reqs[i].response or {}
+        assert resp.get("ok"), resp
+        out.append(resp)
+    return out
+
+
+# ---------------------------------------------------------- equivalence
+
+
+@pytest.mark.parametrize("page_size", [None, 0], ids=["paged", "slots"])
+@pytest.mark.parametrize("k", [2, 4, 8])
+def test_speculative_matches_static_greedy(clf, page_size, k):
+    """Byte-identical greedy text at every draft depth, both backends,
+    shuffled arrival — acceptance is exact argmax equality and the
+    correction token is the argmax itself, so no interleaving of
+    accepted blocks and plain ticks can change a byte."""
+    want = clf.generate_batch(PROMPTS, max_new_tokens=16)
+    kwargs = dict(n_slots=4, speculate_k=k)
+    if page_size is not None:
+        kwargs["page_size"] = page_size
+    sched = _scheduler(clf, **kwargs)
+    order = list(range(len(PROMPTS)))
+    random.Random(k).shuffle(order)
+    got = [r["text"] for r in _run(sched, PROMPTS, order=order)]
+    assert got == want
+    spec = sched.stats()["speculation"]
+    assert spec["enabled"] and spec["k"] == k
+    assert spec["fallbacks"] == 0
+
+
+def test_mixed_budgets_freeze_identically(clf):
+    """Per-request budgets truncate exactly under speculation: drafts
+    past a slot's budget are never proposed, the commit clamp never
+    exceeds it, and the bytes match the plain scheduler's."""
+    budgets = [1, 2, 3, 16, 1, 2, 3, 16]
+    plain = _scheduler(clf, n_slots=4, speculate_k=0)
+    want = [r["text"] for r in _run(plain, PROMPTS, budgets=budgets)]
+    sched = _scheduler(clf, n_slots=4, speculate_k=8)
+    got = _run(sched, PROMPTS, budgets=budgets)
+    assert [r["text"] for r in got] == want
+    for resp, budget in zip(got, budgets):
+        assert resp["tokens"] <= budget
+
+
+def test_eos_latch_survives_accepted_blocks(clf):
+    """Streams that emit EOS mid-block settle at the EOS position — the
+    verify scan carries no latch; the host truncates at the first EOS in
+    the committed prefix, so text matches the static scan exactly."""
+    want = clf.generate_batch(EOS_PROMPTS, max_new_tokens=16)
+    sched = _scheduler(clf, n_slots=4, speculate_k=4)
+    got = [r["text"] for r in _run(sched, EOS_PROMPTS)]
+    assert got == want
+
+
+# --------------------------------------------------------------- shapes
+
+
+def test_verify_joins_warmup_ladder_only_when_on(clf):
+    """speculate_k>0 adds exactly one warmed program per backend (the
+    verify block); the default ladder stays 4 paged / 5 monolithic as
+    asserted in test_continuous."""
+    paged = _scheduler(clf, n_slots=2, speculate_k=4)
+    record = paged.warmup()
+    assert record["kv_backend"] == "paged"
+    assert record["programs"] == 5
+    assert record["speculate_k"] == 4
+
+    mono = _scheduler(clf, n_slots=2, page_size=0, speculate_k=4)
+    record = mono.warmup()
+    assert record["kv_backend"] == "slots"
+    assert record["programs"] == 6
+
+
+def test_zero_retraces_across_speculative_workload(clf):
+    """The verify program is one fixed shape: adaptive draft depth,
+    mixed budgets, EOS, and plain-tick fallbacks all run inside it."""
+    sched = _scheduler(clf, n_slots=4, speculate_k=4)
+    sched.warmup()
+    variants = sched.runtime.compiled_variants()
+    budgets = [16, 1, 16, 3, 16, 2, 16, 16]
+    _run(sched, PROMPTS, budgets=budgets)
+    _run(sched, PROMPTS[:4])
+    assert sched.runtime.compiled_variants() == variants
+
+
+def test_speculate_k_capped_to_budget_region(clf):
+    """A draft block must fit the decode region: k is capped at
+    construction to max_new - 1, keeping the verify shape legal."""
+    sched = _scheduler(clf, n_slots=2, max_new_tokens=4, speculate_k=64)
+    assert sched.speculate_k == 3
+
+
+def test_speculation_stats_populated(clf):
+    sched = _scheduler(clf, n_slots=4, speculate_k=4)
+    _run(sched, ["la la la la la la", "do do do do do do"] * 2)
+    spec = sched.stats()["speculation"]
+    assert spec["enabled"] and spec["k"] == 4
+    assert spec["plain_ticks"] + spec["dispatches"] > 0
+    if spec["dispatches"]:
+        assert spec["accepted_tokens_per_dispatch"] >= 1.0
+        assert spec["acceptance_rate"] is not None
+    assert "acceptance_rate_hist" in spec
+    assert "accepted_tokens_hist" in spec
+
+    plain = _scheduler(clf, n_slots=2, speculate_k=0)
+    stats = plain.stats()["speculation"]
+    assert not stats["enabled"] and stats["k"] == 0
+
+
+# ----------------------------------------------------------- resilience
+
+
+def test_draft_fault_degrades_to_plain_decode(clf):
+    """An injected ``spec.draft`` fault costs the tick's speedup, never
+    a token: bytes identical to the clean run, fallbacks counted."""
+    from music_analyst_tpu.resilience import configure_faults
+
+    want = clf.generate_batch(PROMPTS[:4], max_new_tokens=16)
+    sched = _scheduler(clf, n_slots=4, speculate_k=4)
+    configure_faults("spec.draft:error@1+")
+    try:
+        got = [r["text"] for r in _run(sched, PROMPTS[:4])]
+    finally:
+        configure_faults(None)
+    assert got == want
+    spec = sched.stats()["speculation"]
+    assert spec["fallbacks"] > 0
+    assert spec["dispatches"] == 0  # every eligible tick fell back
+
+
+def test_preempt_resume_mid_speculation_byte_identical(clf):
+    """SLO preemption lands while slots are speculating: the victim
+    checkpoints, resumes O(1), and every request's bytes still match
+    the static scan — speculation state (draft cache, EWMA) is host-only
+    and rebuilt, never persisted wrong."""
+    low_prompts = PROMPTS[:2]
+    high_prompt = PROMPTS[7]
+    static = clf.generate_batch(low_prompts + [high_prompt],
+                                max_new_tokens=16)
+    sched = _scheduler(clf, n_slots=2, speculate_k=4, ttft_slo_ms=1.0,
+                       kv_pages=24)
+    sched.warmup()
+    variants = sched.runtime.compiled_variants()
+    low = [
+        sched.submit(i, p, priority=1, deadline_ms=60_000.0)
+        for i, p in enumerate(low_prompts)
+    ]
+    for _ in range(64):
+        sched._tick()
+        if any(s is not None and s.active and s.steps > 0
+               for s in sched._slots):
+            break
+    high = sched.submit("gold", high_prompt, priority=5,
+                        deadline_ms=60_000.0)
+    for _ in range(64):
+        if sched.stats()["preemptions"] >= 1:
+            break
+        sched._tick()
+    sched.run_until_idle()
+    for req, want in zip(low, static[:2]):
+        assert req.response["ok"], req.response
+        assert req.response["text"] == want
+    assert high.response["ok"] and high.response["text"] == static[-1]
+    stats = sched.stats()
+    assert stats["preemptions"] >= 1
+    assert stats["resumed_o1"] >= 1
+    assert stats["resume_chunks_skipped"] >= 1
+    assert sched.runtime.compiled_variants() == variants
+
+
+# ---------------------------------------------------------------- knobs
+
+
+def test_resolve_speculate_k(monkeypatch):
+    monkeypatch.delenv("MUSICAAL_SERVE_SPECULATE_K", raising=False)
+    assert resolve_speculate_k(None) == 0  # off by default
+    assert resolve_speculate_k(4) == 4
+    monkeypatch.setenv("MUSICAAL_SERVE_SPECULATE_K", "6")
+    assert resolve_speculate_k(None) == 6
+    monkeypatch.setenv("MUSICAAL_SERVE_SPECULATE_K", "junk")
+    assert resolve_speculate_k(None) == 0  # malformed env falls back
+    with pytest.raises(ValueError):
+        resolve_speculate_k("junk")  # explicit value is a usage error
+    with pytest.raises(ValueError):
+        resolve_speculate_k(-1)
+
+
+# ---------------------------------------------------------------- dedup
+
+
+def test_identical_inflight_generates_fold_to_one_slot(clf):
+    """Greedy decode is deterministic, so identical in-flight
+    (tenant, prompt, budget) generate requests compute once: followers
+    fold onto the primary's slot and the reply fans out under each
+    request's own id."""
+    sched = _scheduler(clf, n_slots=2, speculate_k=4)
+    same = [
+        sched.submit(f"dup-{i}", "one hit song", max_new_tokens=8)
+        for i in range(4)
+    ]
+    other = sched.submit("solo", "a different tune", max_new_tokens=8)
+    # Same prompt at a different budget is a different stream: no fold.
+    longer = sched.submit("long", "one hit song", max_new_tokens=12)
+    sched.run_until_idle()
+    texts = set()
+    for req in same:
+        assert req.response["ok"], req.response
+        assert req.response["id"] == req.id
+        texts.add(req.response["text"])
+    assert len(texts) == 1
+    assert other.response["ok"] and longer.response["ok"]
+    assert longer.response["text"].startswith(next(iter(texts)))
+    assert sched.stats()["dedup_folded"] == 3
+
+
+@pytest.mark.slow
+def test_continuous_suite_speculation_bar(monkeypatch):
+    """The continuous suite's speculation A/B booleans ARE the ISSUE-15
+    bar: ≥2× decode tokens/s on the chorus-like smoke workload,
+    byte-identical greedy text, strictly fewer decode dispatches, zero
+    retraces."""
+    monkeypatch.setenv("MUSICAAL_BENCH_SMOKE", "1")
+    from benchmarks.continuous import _speculation_ab
+
+    row = _speculation_ab(
+        n_requests=16, n_slots=8, budget=128, speculate_k=8
+    )
+    assert row["identical_outputs"] is True
+    assert row["fewer_dispatches"] is True
+    assert row["zero_retrace"] is True
+    assert row["speedup_ok"] is True, row
+
+
+# ------------------------------------------------------------- reporting
+
+
+def test_report_aggregates_speculation(tmp_path):
+    """telemetry-report rolls the manifest's serving.decode.speculation
+    sections into cross-run acceptance/accepted-tokens quantiles."""
+    from music_analyst_tpu.observability.report import (
+        build_report,
+        load_run,
+        render_report,
+    )
+
+    def _manifest(label, rate, atpd):
+        return {
+            "run": label, "ok": True, "wall_seconds": 1.0,
+            "serving": {
+                "decode": {
+                    "speculation": {
+                        "enabled": True, "k": 8, "dispatches": 73,
+                        "plain_ticks": 4, "fallbacks": 0,
+                        "acceptance_rate": rate,
+                        "accepted_tokens_per_dispatch": atpd,
+                    },
+                },
+            },
+        }
+
+    records = []
+    for i, (rate, atpd) in enumerate([(0.91, 6.2), (0.97, 7.8)]):
+        run_dir = tmp_path / f"run{i}"
+        run_dir.mkdir()
+        (run_dir / "run_manifest.json").write_text(
+            json.dumps(_manifest(f"run{i}", rate, atpd))
+        )
+        records.append(load_run(str(run_dir)))
+    report = build_report(records)
+    spec = report["speculation"]
+    assert [r["label"] for r in spec["runs"]] == ["run0", "run1"]
+    assert spec["acceptance_rate"]["n"] == 2
+    assert spec["acceptance_rate"]["max"] == 0.97
+    assert spec["accepted_tokens_per_dispatch"]["p50"] == 6.2
+    text = "\n".join(render_report(report))
+    assert "speculative decoding" in text
+    assert "acceptance rate across 2 run(s)" in text
+
+    # A spec-off run contributes nothing: the block stays empty.
+    plain = build_report([{
+        "label": "plain", "kind": "run_dir", "ok": True,
+        "error": None, "error_kind": None,
+        "serving": {"decode": {"speculation": {"enabled": False}}},
+    }])
+    assert plain["speculation"]["runs"] == []
+    assert plain["speculation"]["acceptance_rate"] is None
+    assert "speculative decoding" not in "\n".join(render_report(plain))

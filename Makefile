@@ -91,7 +91,8 @@ smoke:
 		tests/test_wq_store.py tests/test_serving.py \
 		tests/test_resilience.py tests/test_continuous.py \
 		tests/test_kv_pages.py tests/test_router.py \
-		tests/test_journal.py tests/test_speculative.py -q
+		tests/test_journal.py tests/test_speculative.py \
+		tests/test_reqtrace.py -q
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= MUSICAAL_BENCH_SMOKE=1 \
 		$(PY) bench.py --baseline --attempts 1 --deadline 240 \
 		| $(PY) -c "import json,sys; \
@@ -287,6 +288,36 @@ print('smoke ok:', payload['metric'], payload['value'])"
 	grep -q '"retry.ingest.read"' "$$chaostmp/faulted/run_manifest.json" || \
 		{ echo "injected run manifest lacks the retry counter"; exit 1; }; \
 	echo "chaos injected-fault self-check ok"
+	# trace self-check: one traced generate request under --trace-sample
+	# 1.0 — request_traces.jsonl must hold its waterfall with >=6 phases
+	# whose span sum covers >=95% of the request's measured wire latency,
+	# and trace-report must reconstruct a complete waterfall (exit 0).
+	tracetmp=$$(mktemp -d) && trap 'rm -rf "$$tracetmp"' EXIT && \
+	printf '%s\n' \
+		'{"id":"t1","op":"generate","text":"sunny morning","max_new_tokens":4}' | \
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+		$(PY) -m music_analyst_tpu serve --stdio --model llama-tiny --quiet \
+		--slots 2 --prefill-chunk 32 --max-new-tokens 4 \
+		--max-batch 2 --max-wait-ms 2 --trace-sample 1.0 \
+		--profile-dir "$$tracetmp" --telemetry-dir "$$tracetmp" \
+		> "$$tracetmp/replies.ndjson" || { echo "traced serve run failed"; exit 1; }; \
+	$(PY) -c "import json,sys; \
+	lines=[json.loads(l) for l in open(sys.argv[1]) if l.strip()]; \
+	assert lines and lines[0]['ok'] and 'trace_id' in lines[0], lines; \
+	recs=[json.loads(l) for l in open(sys.argv[2]) if l.strip()]; \
+	rec=[r for r in recs if r['trace_id']==lines[0]['trace_id']][0]; \
+	phases=[s for s in rec['spans'] if s['cat']=='phase']; \
+	assert len(phases)>=6, [s['name'] for s in phases]; \
+	cover=sum(s['dur'] for s in phases); \
+	assert cover >= 0.95*rec['wire_s'], (cover, rec['wire_s']); \
+	print('trace self-check ok:', len(phases), 'phases,', \
+	      round(100.0*cover/rec['wire_s'],1), 'pct coverage')" \
+		"$$tracetmp/replies.ndjson" "$$tracetmp/request_traces.jsonl" || \
+		{ echo "trace self-check failed"; exit 1; }; \
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+		$(PY) -m music_analyst_tpu trace-report "$$tracetmp" >/dev/null || \
+		{ echo "trace-report self-check failed"; exit 1; }; \
+	echo "trace-report self-check ok"
 	# overload self-check: burst one stdio stream past a 1 req/s bulk
 	# tenant budget while a single high-priority gold request rides along
 	# — gold must be answered ok inside its (generous) TTFT SLO, every

@@ -354,6 +354,90 @@ def _spec_draft_scenario(n_requests: int) -> dict:
     }
 
 
+def _reqtrace_flush_scenario(n_requests: int) -> dict:
+    """Injected trace-flush failure (site ``reqtrace.flush``): every
+    flush attempt fails, so kept traces degrade to counted
+    ``trace_drops`` — the replies themselves are untouched (same labels
+    as the clean traced run, everything answers) and no torn trace file
+    appears.  Tracing must never block the reply path."""
+    from music_analyst_tpu.resilience import configure_faults, fault_stats
+    from music_analyst_tpu.serving.batcher import DynamicBatcher
+    from music_analyst_tpu.telemetry.reqtrace import (
+        TRACE_FILE,
+        configure_reqtrace,
+    )
+
+    ops = {"echo": lambda texts: [{"label": t.upper()} for t in texts]}
+
+    def _run(tag: str, trace_dir: str):
+        rt = configure_reqtrace(1.0, directory=trace_dir, role="bench")
+        batcher = DynamicBatcher(
+            ops, max_batch=8, max_wait_ms=1.0, max_queue=n_requests + 1
+        ).start()
+        try:
+            reqs = [
+                batcher.submit(f"{tag}-{i}", "echo", f"chaos row {i}")
+                for i in range(n_requests)
+            ]
+            for req in reqs:
+                if not req.wait(timeout=60.0):
+                    raise RuntimeError(f"request {req.id} never settled")
+                # The reply-write seam (server.py) owns finish_request;
+                # this in-process drive replays it per settled reply so
+                # the real flush path — and its fault gate — runs.
+                rt.finish_request(req)
+        finally:
+            batcher.drain()
+        labels = [(r.response or {}).get("label") for r in reqs]
+        return labels, rt.stats()
+
+    try:
+        with tempfile.TemporaryDirectory(prefix="chaos_traces_") as base:
+            clean_dir = os.path.join(base, "clean")
+            faulted_dir = os.path.join(base, "faulted")
+            start = time.perf_counter()
+            clean_labels, clean_stats = _run("clean", clean_dir)
+            configure_faults("reqtrace.flush:error@1+")
+            try:
+                faulted_labels, faulted_stats = _run("faulted", faulted_dir)
+                trips = fault_stats()["reqtrace.flush"]["trips"]
+            finally:
+                configure_faults(None)
+            elapsed = time.perf_counter() - start
+            trace_path = os.path.join(faulted_dir, TRACE_FILE)
+            faulted_file_empty = (
+                not os.path.exists(trace_path)
+                or os.path.getsize(trace_path) == 0
+            )
+    finally:
+        # configure_reqtrace exported the dir/sample env for worker
+        # inheritance — clear them so the disabled recorder stays off.
+        os.environ.pop("MUSICAAL_TRACE_DIR", None)
+        os.environ.pop("MUSICAAL_TRACE_SAMPLE", None)
+        configure_reqtrace(None, None)
+    return {
+        "scenario": "reqtrace_flush_fault",
+        "spec": "reqtrace.flush:error@1+",
+        "requests": n_requests,
+        "bytes_identical": faulted_labels == clean_labels,
+        "all_answered": (
+            all(label is not None for label in faulted_labels)
+            and len(faulted_labels) == n_requests
+        ),
+        "flushed_clean": clean_stats["flushed"],
+        "trace_drops": faulted_stats["trace_drops"],
+        "trips": trips,
+        "faulted_file_empty": faulted_file_empty,
+        "degraded_to_drops": (
+            clean_stats["flushed"] == n_requests
+            and faulted_stats["trace_drops"] == n_requests
+            and faulted_stats["flushed"] == 0
+            and faulted_file_empty
+        ),
+        "wall_s": round(elapsed, 4),
+    }
+
+
 def _journal_scenario() -> dict:
     """Faulted appends + a torn segment tail (site ``journal.append``):
     the server-side append failure is absorbed (the request still
@@ -604,6 +688,15 @@ def run() -> dict:
             file=sys.stderr,
         )
 
+        reqtrace_flush = _reqtrace_flush_scenario(16 if smoke() else 128)
+        print(
+            f"[chaos] reqtrace_flush: identical="
+            f"{reqtrace_flush['bytes_identical']} "
+            f"drops={reqtrace_flush['trace_drops']} "
+            f"degraded={reqtrace_flush['degraded_to_drops']}",
+            file=sys.stderr,
+        )
+
     reset_retry_stats()
     return {
         "suite": "chaos",
@@ -620,10 +713,12 @@ def run() -> dict:
         "spec_draft": spec_draft,
         "preempt_fault": preempt,
         "journal_append": journal_wal,
+        "reqtrace_flush": reqtrace_flush,
         "all_identical": all(
             s["bytes_identical"] for s in scenarios
         ) and prefix["bytes_identical"] and spec_draft["bytes_identical"]
-        and preempt["bytes_identical"],
+        and preempt["bytes_identical"]
+        and reqtrace_flush["bytes_identical"],
         "all_recovered": all(
             s["trips"] > 0
             and (s["degraded"] if s["expect_degraded"] else True)
@@ -633,5 +728,6 @@ def run() -> dict:
         and spec_draft["all_fell_back"]
         and preempt["preempt_faults"] > 0
         and preempt["preemptions_faulted"] == 0
-        and journal_wal["degraded_to_recompute"],
+        and journal_wal["degraded_to_recompute"]
+        and reqtrace_flush["degraded_to_drops"],
     }

@@ -214,6 +214,11 @@ class LoadGen:
         sheds_with_hint = 0
         latencies: Dict[str, List[float]] = {}
         by_tenant: Dict[str, Dict[str, int]] = {}
+        # Server-assigned trace ids (reqtrace): counted per outcome class
+        # so the SLO suite can join offered-load outcomes — sheds
+        # included — to the waterfalls in request_traces.jsonl.
+        traces = {"ok_with_id": 0, "shed_with_id": 0, "failed_with_id": 0}
+        trace_ids: List[str] = []
         for arrival, req in live:
             tkey = f"{arrival.tenant}/p{arrival.priority}"
             bucket = by_tenant.setdefault(
@@ -224,9 +229,14 @@ class LoadGen:
                 silent += 1  # the contract breach: never settled
                 continue
             resp = req.response or {}
+            trace_id = resp.get("trace_id")
+            if isinstance(trace_id, str) and len(trace_ids) < 20:
+                trace_ids.append(trace_id)
             if resp.get("ok"):
                 ok += 1
                 bucket["ok"] += 1
+                if isinstance(trace_id, str):
+                    traces["ok_with_id"] += 1
                 if req.t_settle is not None:
                     latencies.setdefault(tkey, []).append(
                         (req.t_settle - req.t_enqueue) * 1000.0
@@ -237,11 +247,15 @@ class LoadGen:
             if kind in _SHED_KINDS:
                 sheds[kind] += 1
                 bucket["shed"] += 1
+                if isinstance(trace_id, str):
+                    traces["shed_with_id"] += 1
                 if isinstance(error.get("retry_after_ms"), (int, float)):
                     sheds_with_hint += 1
             else:
                 failed += 1
                 bucket["failed"] += 1
+                if isinstance(trace_id, str):
+                    traces["failed_with_id"] += 1
         latency_ms = {}
         for tkey, vals in sorted(latencies.items()):
             vals.sort()
@@ -267,4 +281,5 @@ class LoadGen:
             "replay_wall_s": round(replay_wall_s, 4),
             "latency_ms": latency_ms,
             "tenants": by_tenant,
+            "traces": {**traces, "ids_sample": trace_ids},
         }

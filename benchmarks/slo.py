@@ -154,8 +154,18 @@ def _flash_crowd_scenario(seed: int) -> dict:
 def _faulted_trace_scenario(seed: int) -> dict:
     """The flash-crowd trace with seeded ``loadgen.tick`` faults armed:
     faulted ticks drop offered requests before submission, everything
-    that WAS submitted still settles — degraded load, intact target."""
+    that WAS submitted still settles — degraded load, intact target.
+
+    Runs with request tracing enabled: every settled reply — the sheds
+    included — must carry a server-assigned ``trace_id`` the report can
+    join back to ``request_traces.jsonl``.
+    """
+    import os
+    import shutil
+    import tempfile
+
     from music_analyst_tpu.resilience import configure_faults, fault_stats
+    from music_analyst_tpu.telemetry.reqtrace import configure_reqtrace
 
     duration = 0.8 if smoke() else 4.0
     trace = flash_crowd_arrivals(
@@ -163,6 +173,8 @@ def _faulted_trace_scenario(seed: int) -> dict:
         duration * 0.25, duration * 0.35, seed=seed,
         classes=[{"tenant": "bulk", "deadline_ms": 150.0}],
     )
+    trace_dir = tempfile.mkdtemp(prefix="slo_traces_")
+    configure_reqtrace(0.0, directory=trace_dir, role="bench")
     batcher = _batcher(max_queue=16, ttft_slo_ms=_GOLD_SLO_MS)
     configure_faults(f"loadgen.tick:error@10%seed={seed}")
     try:
@@ -171,11 +183,21 @@ def _faulted_trace_scenario(seed: int) -> dict:
     finally:
         configure_faults(None)
         batcher.drain()
+        # configure_reqtrace exported the dir/sample env for worker
+        # inheritance — clear them so the disabled recorder stays off.
+        os.environ.pop("MUSICAAL_TRACE_DIR", None)
+        os.environ.pop("MUSICAAL_TRACE_SAMPLE", None)
+        configure_reqtrace(None, None)
+        shutil.rmtree(trace_dir, ignore_errors=True)
+    traces = report["traces"]
     report.update(
         scenario="faulted_trace",
         spec=f"loadgen.tick:error@10%seed={seed}",
         trips=trips,
         trips_match=trips == report["ticks_faulted"],
+        traced=True,
+        sheds_carry_trace_ids=traces["shed_with_id"] == report["shed"],
+        ok_carry_trace_ids=traces["ok_with_id"] == report["ok"],
     )
     return report
 
@@ -260,7 +282,9 @@ def run() -> dict:
           f"shed={flash['shed']}", file=sys.stderr)
     faulted = _faulted_trace_scenario(seed)
     print(f"[slo] faulted_trace: ticks_faulted={faulted['ticks_faulted']} "
-          f"silent={faulted['silent_drops']}", file=sys.stderr)
+          f"silent={faulted['silent_drops']} "
+          f"sheds_traced={faulted['sheds_carry_trace_ids']}",
+          file=sys.stderr)
     preempt = _preempt_scenario()
     print(f"[slo] preempt_resume: preemptions={preempt['preemptions']} "
           f"identical={preempt['bytes_identical']} "
@@ -282,4 +306,5 @@ def run() -> dict:
         ),
         "preempt_bytes_identical": preempt["bytes_identical"],
         "zero_retraces": preempt["retraces"] == 0,
+        "sheds_carry_trace_ids": faulted["sheds_carry_trace_ids"],
     }

@@ -11,9 +11,11 @@ with the same flags plus TPU-era additions (``--device``, ``--batch-size``):
 TPU-era subcommands with no reference analogue: ``serve`` (resident
 NDJSON inference server with dynamic batching, serving/), ``sweep``
 (scaling sweeps), ``validate`` (weight certification), ``profile-diff``
-(the perf-regression gate over run manifests / bench lines), and
+(the perf-regression gate over run manifests / bench lines),
 ``telemetry-report`` (cross-run analytics over telemetry dirs + bench
-captures).  Every run-scoped subcommand takes ``--profile-dir`` to
+captures), and ``trace-report`` (per-request waterfalls + critical-path
+attribution over request_traces.jsonl).  Every run-scoped subcommand
+takes ``--profile-dir`` to
 capture device + span traces and ``--watchdog-timeout`` to arm the
 hang-classifying heartbeat watchdog (observability/).
 """
@@ -281,6 +283,23 @@ def _add_telemetry_report(sub: argparse._SubParsersAction) -> None:
                         "instead of text")
 
 
+def _add_trace_report(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "trace-report",
+        help="per-request waterfalls: reconstruct cross-process traces "
+             "from request_traces.jsonl and attribute each request's "
+             "wire latency to its phases (observability/report.py); "
+             "exit 1 when no complete waterfall was found",
+    )
+    p.add_argument("sources", nargs="+",
+                   help="Trace sources: profile dirs holding "
+                        "request_traces*.jsonl, or the .jsonl files "
+                        "themselves")
+    p.add_argument("--json", action="store_true",
+                   help="Emit the reconstructed traces as one JSON object "
+                        "instead of waterfall text")
+
+
 def _add_serve(sub: argparse._SubParsersAction) -> None:
     p = sub.add_parser(
         "serve",
@@ -384,6 +403,14 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                         "pays compile cost)")
     p.add_argument("--quiet", action="store_true",
                    help="Suppress stderr status lines")
+    p.add_argument("--trace-sample", default=None, metavar="P",
+                   help="Per-request distributed tracing head-sample "
+                        "probability in [0, 1]; sampled (plus every shed/"
+                        "preempted/requeued/SLO-missed) request flushes "
+                        "its span waterfall to request_traces.jsonl under "
+                        "--profile-dir (default $MUSICAAL_TRACE_SAMPLE "
+                        "or 0; requires --profile-dir or "
+                        "$MUSICAAL_TRACE_DIR)")
     _add_telemetry_flags(p)
 
 
@@ -416,6 +443,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_validate(sub)
     _add_profile_diff(sub)
     _add_telemetry_report(sub)
+    _add_trace_report(sub)
     args = parser.parse_args(argv)
 
     if args.command == "profile-diff":
@@ -436,6 +464,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
 
         return run_telemetry_report(args.sources, json_output=args.json)
+
+    if args.command == "trace-report":
+        # Same posture: pure host-side reconstruction over trace files,
+        # never configures telemetry or jax.
+        from music_analyst_tpu.observability.report import run_trace_report
+
+        return run_trace_report(args.sources, json_output=args.json)
 
     from music_analyst_tpu.telemetry import configure
 
@@ -657,6 +692,8 @@ def _dispatch(parser: argparse.ArgumentParser,
                 tenant_budget=args.tenant_budget,
                 priority=args.priority,
                 journal_dir=args.journal_dir,
+                trace_sample=args.trace_sample,
+                trace_dir=args.profile_dir,
             )
             if resolve_replicas(args.replicas) > 1:
                 from music_analyst_tpu.serving.router import run_router

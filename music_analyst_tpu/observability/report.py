@@ -222,6 +222,11 @@ def _dir_record(directory: str, label: str) -> Optional[Dict[str, Any]]:
         serving = manifest.get("serving")
         if serving:
             rec["serving"] = serving
+        # Tail-sampled trace exemplars (telemetry/reqtrace.py): quantile
+        # trace ids that dereference into request_traces.jsonl.
+        exemplars = manifest.get("trace_exemplars")
+        if exemplars:
+            rec["trace_exemplars"] = exemplars
         resilience = manifest.get("resilience")
         if resilience:
             rec["resilience"] = resilience
@@ -330,13 +335,22 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         if rec.get("recompiles"):
             recompiles[rec["label"]] = rec["recompiles"]
         for name, q in (rec.get("latency_quantiles") or {}).items():
-            latencies.append({
+            entry = {
                 "label": rec["label"],
                 "name": name,
                 "p50_s": q.get("p50_s"),
                 "p95_s": q.get("p95_s"),
                 "p99_s": q.get("p99_s"),
-            })
+            }
+            # Attach the matching trace exemplars so "p99 is slow" comes
+            # with a trace id to pull the waterfall for.
+            exemplar = (rec.get("trace_exemplars") or {}).get(name)
+            if isinstance(exemplar, dict):
+                entry["exemplars"] = {
+                    p: exemplar[p]
+                    for p in ("p50", "p95", "p99") if p in exemplar
+                }
+            latencies.append(entry)
         for name, pipe in (rec.get("pipeline") or {}).items():
             for stage in pipe.get("stages") or []:
                 if stage.get("stall_s") or stage.get("queue_depth_max"):
@@ -507,6 +521,13 @@ def render_report(report: Dict[str, Any]) -> List[str]:
                 f"{_fmt(q['p50_s'])} / {_fmt(q['p95_s'])} / "
                 f"{_fmt(q['p99_s'])}"
             )
+            exemplars = q.get("exemplars") or {}
+            if exemplars:
+                shown = " ".join(
+                    f"{p}={exemplars[p].get('trace_id')}"
+                    for p in ("p50", "p95", "p99") if p in exemplars
+                )
+                lines.append(f"    trace exemplars: {shown}")
     if report.get("resilience"):
         lines.append(
             "fault/retry recovery (trips / retries / recoveries / "
@@ -605,3 +626,295 @@ def run_telemetry_report(
         for line in render_report(report):
             print(line)
     return 0 if report["newest"]["ok"] else 1
+
+
+# ----------------------------------------------------------- trace-report
+#
+# ``trace-report`` reconstructs cross-process request waterfalls from the
+# per-process records in ``request_traces.jsonl`` (telemetry/reqtrace.py:
+# each process that handled a kept request appended ONE line with its
+# spans).  Records sharing a ``trace_id`` are one request's journey; the
+# ``parent`` span pointer links a replica worker's record back to the
+# router front end's record.  Jax-free, like telemetry-report.
+
+from music_analyst_tpu.telemetry.reqtrace import (  # noqa: E402  (jax-free)
+    PHASE_NAMES,
+    TRACE_FILE,
+)
+
+_MAX_RENDERED_TRACES = 20
+
+
+def _iter_trace_files(source: str) -> List[str]:
+    """A source is a trace .jsonl itself, or a directory holding
+    ``request_traces*.jsonl`` (the profile dir)."""
+    if os.path.isdir(source):
+        out = []
+        try:
+            names = sorted(os.listdir(source))
+        except OSError:
+            return []
+        stem = TRACE_FILE[: -len(".jsonl")]
+        for name in names:
+            if name.startswith(stem) and name.endswith(".jsonl"):
+                out.append(os.path.join(source, name))
+        return out
+    if source.endswith(".jsonl") and os.path.exists(source):
+        return [source]
+    return []
+
+
+def load_trace_records(sources: List[str]) -> List[Dict[str, Any]]:
+    """Every parseable trace record across all sources, input order."""
+    records: List[Dict[str, Any]] = []
+    for source in sources:
+        for path in _iter_trace_files(source):
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    for line in fh:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
+                        if (isinstance(rec, dict)
+                                and isinstance(rec.get("trace_id"), str)
+                                and isinstance(rec.get("spans"), list)):
+                            records.append(rec)
+            except OSError:
+                continue
+    return records
+
+
+def _phase_spans(record: Dict[str, Any]) -> List[Dict[str, Any]]:
+    return [
+        s for s in record.get("spans") or []
+        if isinstance(s, dict) and s.get("cat") == "phase"
+        and s.get("name") in PHASE_NAMES
+        and isinstance(s.get("t"), (int, float))
+        and isinstance(s.get("dur"), (int, float))
+    ]
+
+
+def _span_extent(record: Dict[str, Any]) -> Optional[float]:
+    phases = _phase_spans(record)
+    if not phases:
+        return None
+    t0 = min(s["t"] for s in phases)
+    t1 = max(s["t"] + s["dur"] for s in phases)
+    return max(t1 - t0, 0.0)
+
+
+def _pick_root(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The request's entry process: a record with no parent span, else
+    the one whose admit phase starts earliest (a journal-replay record
+    points at a crashed predecessor whose line may never have landed)."""
+    roots = [r for r in records if not r.get("parent")]
+    pool = roots or records
+
+    def admit_t(rec: Dict[str, Any]) -> float:
+        starts = [
+            s["t"] for s in _phase_spans(rec) if s["name"] == "admit"
+        ]
+        if starts:
+            return min(starts)
+        phases = _phase_spans(rec)
+        return min((s["t"] for s in phases), default=float("inf"))
+
+    return min(pool, key=admit_t)
+
+
+def build_waterfall(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """One trace id's records → waterfall + critical-path attribution.
+
+    Attribution uses the ROOT record's phase spans only: by construction
+    (the cursor partition in reqtrace.py) they tile the root process's
+    wall time, so their shares of the wire latency are exact and sum to
+    the coverage figure.  Child records (replica workers) show up both
+    as the root's ``downstream`` phase and, nested, as their own
+    per-phase breakdown under ``downstream/``.
+    """
+    root = _pick_root(records)
+    phases = _phase_spans(root)
+    wire = root.get("wire_s")
+    if not isinstance(wire, (int, float)) or wire < 0:
+        wire = _span_extent(root)
+    phase_seconds: Dict[str, float] = {}
+    for span in phases:
+        phase_seconds[span["name"]] = (
+            phase_seconds.get(span["name"], 0.0) + span["dur"]
+        )
+    covered = sum(phase_seconds.values())
+    coverage = (covered / wire) if wire else None
+    attribution = {
+        name: {
+            "seconds": round(seconds, 6),
+            "share": round(seconds / wire, 4) if wire else None,
+        }
+        for name, seconds in sorted(
+            phase_seconds.items(), key=lambda kv: -kv[1]
+        )
+    }
+    children = [
+        r for r in records
+        if r is not root and r.get("parent") == root.get("span")
+    ]
+    downstream: Dict[str, Any] = {}
+    for child in children:
+        breakdown: Dict[str, float] = {}
+        for span in _phase_spans(child):
+            breakdown[span["name"]] = (
+                breakdown.get(span["name"], 0.0) + span["dur"]
+            )
+        downstream[f"{child.get('role', 'worker')}:{child.get('span')}"] = {
+            name: round(seconds, 6)
+            for name, seconds in sorted(
+                breakdown.items(), key=lambda kv: -kv[1]
+            )
+        }
+    phase_names = {s["name"] for s in phases}
+    complete = (
+        "admit" in phase_names
+        and "reply" in phase_names
+        and isinstance(wire, (int, float)) and wire is not None
+    )
+    out: Dict[str, Any] = {
+        "trace_id": root["trace_id"],
+        "complete": complete,
+        "wire_s": round(wire, 6) if isinstance(wire, (int, float)) else None,
+        "coverage": round(coverage, 4) if coverage is not None else None,
+        "kept": root.get("kept"),
+        "op": root.get("op"),
+        "tenant": root.get("tenant"),
+        "role": root.get("role"),
+        "n_records": len(records),
+        "attribution": attribution,
+        "records": records,
+    }
+    if downstream:
+        out["downstream"] = downstream
+    dropped = sum(int(r.get("spans_dropped") or 0) for r in records)
+    if dropped:
+        out["spans_dropped"] = dropped
+    return out
+
+
+def build_trace_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    by_id: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in records:
+        by_id.setdefault(rec["trace_id"], []).append(rec)
+    traces = [build_waterfall(recs) for recs in by_id.values()]
+    traces.sort(key=lambda t: (t["wire_s"] is None, -(t["wire_s"] or 0.0)))
+    complete = [t for t in traces if t["complete"]]
+    kept_reasons: Dict[str, int] = {}
+    for t in traces:
+        reason = t.get("kept") or "?"
+        kept_reasons[reason] = kept_reasons.get(reason, 0) + 1
+    return {
+        "schema": 1,
+        "n_traces": len(traces),
+        "n_complete": len(complete),
+        "n_records": len(records),
+        "kept_reasons": dict(
+            sorted(kept_reasons.items(), key=lambda kv: (-kv[1], kv[0]))
+        ),
+        "traces": traces,
+    }
+
+
+def render_trace_report(report: Dict[str, Any]) -> List[str]:
+    """Waterfall text: one block per trace (slowest first), each span on
+    its own line offset-aligned to the trace's start."""
+
+    def _pct(value: Any) -> str:
+        return f"{value * 100.0:.1f}%" if isinstance(value, float) else "-"
+
+    lines = [
+        f"trace-report: {report['n_traces']} trace(s) "
+        f"({report['n_complete']} complete) from "
+        f"{report['n_records']} process record(s)"
+    ]
+    if report["kept_reasons"]:
+        shown = ", ".join(
+            f"{k}={n}" for k, n in report["kept_reasons"].items()
+        )
+        lines.append(f"kept: {shown}")
+    for trace in report["traces"][:_MAX_RENDERED_TRACES]:
+        wire = trace["wire_s"]
+        wire_text = f"{wire:.6f}s" if isinstance(wire, float) else "?"
+        flag = "" if trace["complete"] else "  [INCOMPLETE]"
+        lines.append(
+            f"trace {trace['trace_id']}: wire {wire_text}, "
+            f"coverage {_pct(trace['coverage'])}, kept={trace['kept']}, "
+            f"{trace['n_records']} process(es){flag}"
+        )
+        starts = [
+            s["t"]
+            for rec in trace["records"]
+            for s in rec.get("spans") or []
+            if isinstance(s.get("t"), (int, float))
+        ]
+        t_zero = min(starts) if starts else 0.0
+        for rec in sorted(
+            trace["records"],
+            key=lambda r: min(
+                (s["t"] for s in _phase_spans(r)), default=float("inf")
+            ),
+        ):
+            depth = 0 if not rec.get("parent") else 1
+            pad = "  " * (depth + 1)
+            lines.append(
+                f"{pad}[{rec.get('role', '?')} pid={rec.get('pid')}] "
+                f"span={rec.get('span')}"
+            )
+            for span in sorted(
+                rec.get("spans") or [], key=lambda s: s.get("t", 0.0)
+            ):
+                mark = "·" if span.get("cat") == "detail" else "█"
+                lines.append(
+                    f"{pad}  {mark} {span['name']:<14} "
+                    f"+{span['t'] - t_zero:.6f}s  {span['dur']:.6f}s"
+                )
+        shares = " | ".join(
+            f"{name} {_pct(info['share'])}"
+            for name, info in trace["attribution"].items()
+        )
+        if shares:
+            lines.append(f"  attribution: {shares}")
+        for child, breakdown in (trace.get("downstream") or {}).items():
+            inner = ", ".join(
+                f"{name}={seconds:.6f}s"
+                for name, seconds in breakdown.items()
+            )
+            lines.append(f"  downstream {child}: {inner}")
+    hidden = report["n_traces"] - min(
+        report["n_traces"], _MAX_RENDERED_TRACES
+    )
+    if hidden > 0:
+        lines.append(f"... {hidden} more trace(s) not shown")
+    return lines
+
+
+def run_trace_report(sources: List[str], json_output: bool = False) -> int:
+    """CLI entry.  Exit 0 = at least one complete waterfall, 1 = traces
+    found but none complete, 2 = no usable input — the 0/1/2 gate
+    semantics telemetry-report and profile-diff already use."""
+    import sys
+
+    records = load_trace_records(sources)
+    if not records:
+        print(
+            f"trace-report: no trace records among {len(sources)} "
+            "source(s) (expected request_traces*.jsonl lines)",
+            file=sys.stderr,
+        )
+        return 2
+    report = build_trace_report(records)
+    if json_output:
+        print(json.dumps(report, default=str))
+    else:
+        for line in render_trace_report(report):
+            print(line)
+    return 0 if report["n_complete"] else 1

@@ -73,6 +73,9 @@ SITES = frozenset(
         "serve.reply",
         "journal.append",
         "journal.compact",
+        # Request-trace flush (telemetry/reqtrace.py): a failing flush must
+        # degrade to dropped spans, never block the reply path.
+        "reqtrace.flush",
     }
 )
 

@@ -47,9 +47,10 @@ from music_analyst_tpu.observability import watchdog
 from music_analyst_tpu.resilience.failover import should_failover
 from music_analyst_tpu.resilience.faults import fault_point
 from music_analyst_tpu.resilience.policy import RetryPolicy
-from music_analyst_tpu.serving.slo import FairQueue, TokenBucket
+from music_analyst_tpu.serving.slo import FairQueue, RateMeter, TokenBucket
 from music_analyst_tpu.telemetry import get_telemetry
 from music_analyst_tpu.telemetry.core import Histogram
+from music_analyst_tpu.telemetry.reqtrace import get_reqtrace
 from music_analyst_tpu.utils.shapes import round_pow2
 
 # Flag defaults; $MUSICAAL_SERVE_* overrides, explicit flags win
@@ -295,6 +296,13 @@ class ServeRequest:
         self.deadline_ms = deadline_ms
 
     def complete(self, payload: Dict[str, Any]) -> None:
+        # ONE settle choke point across every path (succeed, each shed
+        # kind, failures, router-relayed replies): the trace recorder
+        # stamps the reply with the request's trace id and tail-keeps
+        # failures here, so no settle path can dodge tracing.
+        rt = get_reqtrace()
+        if rt.enabled:
+            rt.on_complete(self, payload)
         self.t_settle = time.monotonic()
         self.response = payload
         self._done.set()
@@ -384,6 +392,9 @@ class DynamicBatcher:
         # EWMA of observed flush throughput (rows/s) — feeds the
         # ``retry_after_ms`` hint a queue_full shed carries.
         self._flush_rate = 0.0
+        # Rolling-window rates (serving/slo.py RateMeter): what a live
+        # ``stats`` poller reads without differencing cumulative counters.
+        self._rates = {"req_s": RateMeter(), "shed_s": RateMeter()}
 
     # ----------------------------------------------------------- lifecycle
 
@@ -438,6 +449,9 @@ class DynamicBatcher:
             priority=self.default_priority if priority is None else priority,
             deadline_ms=deadline_ms,
         )
+        # Trace context BEFORE the shed ladder: sheds carry trace ids too
+        # (and tail sampling keeps every shed's trace).
+        get_reqtrace().begin_request(req)
         if op not in self._ops:
             req.fail(
                 "bad_request",
@@ -519,6 +533,7 @@ class DynamicBatcher:
             self._tenant_ledger(req.tenant)["admitted"] += 1
             if depth > self._stats["queue_depth_max"]:
                 self._stats["queue_depth_max"] = depth
+        self._rates["req_s"].mark()
         tel.count("serving.admitted")
         tel.gauge("serving.queue_depth", depth)
         return req
@@ -541,6 +556,7 @@ class DynamicBatcher:
             if hint_ms is not None:
                 self._stats["retry_after_ms_last"] = hint_ms
             self._tenant_ledger(req.tenant)["shed"] += 1
+        self._rates["shed_s"].mark()
         get_telemetry().count("serving.shed")
 
     def _drain_estimate_ms(self, queue: FairQueue,
@@ -674,6 +690,8 @@ class DynamicBatcher:
         n_unique = len(uniques)
         padded = round_pow2(n_unique, 1)
         texts = uniques + [""] * (padded - n_unique)
+        rt = get_reqtrace()
+        t0_w = time.time() if rt.enabled else None
         t0 = time.perf_counter()
         try:
             # The dispatch edge is where a wedged device/tunnel would hang
@@ -737,6 +755,18 @@ class DynamicBatcher:
             "serving.batch_occupancy", occupancy,
             buckets=_OCCUPANCY_BUCKETS,
         )
+        if rt.enabled:
+            # Cursor partition: WFQ wait ends when the device dispatch
+            # starts; the batch phase covers dispatch → results.
+            now_w = time.time()
+            for req in batch:
+                tt = req.meta.get("trace_t")
+                if tt is None:
+                    continue
+                rt.phase(req, "queue", tt.get("cursor"), t0_w)
+                rt.phase(req, "batch", t0_w, now_w, op=op,
+                         rows=n_unique, padded=padded)
+                tt["cursor"] = now_w
         for req, row in zip(batch, rows):
             tel.observe(
                 "serving.request_seconds", now - req.t_enqueue,
@@ -772,6 +802,11 @@ class DynamicBatcher:
             flush_rate_rows_s=round(flush_rate, 3),
             latency=latency,
             batch_occupancy_hist=occ,
+            rates={
+                "window_s": self._rates["req_s"].tau_s,
+                "req_s": self._rates["req_s"].rate(),
+                "shed_s": self._rates["shed_s"].rate(),
+            },
         )
         return out
 

@@ -124,8 +124,9 @@ from music_analyst_tpu.serving.batcher import (
     resolve_tpot_slo_ms,
     resolve_ttft_slo_ms,
 )
-from music_analyst_tpu.serving.slo import FairQueue, TokenBucket
+from music_analyst_tpu.serving.slo import FairQueue, RateMeter, TokenBucket
 from music_analyst_tpu.telemetry import get_telemetry
+from music_analyst_tpu.telemetry.reqtrace import get_reqtrace
 from music_analyst_tpu.telemetry.core import Histogram
 from music_analyst_tpu.utils.labels import normalise_label
 
@@ -396,6 +397,12 @@ class ContinuousScheduler:
         }
         self._accept_hist = Histogram(_OCCUPANCY_BUCKETS)
         self._block_hist = Histogram(_ACCEPTED_BUCKETS)
+        # Rolling-window rates (serving/slo.py RateMeter) so a live
+        # ``stats`` poll reads req/s, tokens/s, shed/s directly.
+        self._rates = {
+            "req_s": RateMeter(), "tokens_s": RateMeter(),
+            "shed_s": RateMeter(),
+        }
         # In-batch dedup: live generate primaries by (tenant, text,
         # budget); guarded by _cond (submit side) — fan-out pops under
         # the same lock.
@@ -589,6 +596,8 @@ class ContinuousScheduler:
             ),
             deadline_ms=deadline_ms,
         )
+        # Trace attach BEFORE the shed ladder: sheds carry trace ids too.
+        get_reqtrace().begin_request(req)
         with self._cond:
             if self._draining:
                 req.fail("draining", "server is draining; not admitting")
@@ -632,6 +641,7 @@ class ContinuousScheduler:
                         self._stats["admitted"] += 1
                         self._stats["dedup_folded"] += 1
                         self._tenant_ledger(req.tenant)["admitted"] += 1
+                    self._rates["req_s"].mark()
                     tel.count("serving.decode_admitted")
                     tel.count("serving.decode_dedup_folded")
                     return req
@@ -691,6 +701,7 @@ class ContinuousScheduler:
             self._tenant_ledger(req.tenant)["admitted"] += 1
             if depth > self._stats["queue_depth_max"]:
                 self._stats["queue_depth_max"] = depth
+        self._rates["req_s"].mark()
         tel.count("serving.decode_admitted")
         return req
 
@@ -712,6 +723,7 @@ class ContinuousScheduler:
             if hint_ms is not None:
                 self._stats["retry_after_ms_last"] = hint_ms
             self._tenant_ledger(req.tenant)["shed"] += 1
+        self._rates["shed_s"].mark()
         get_telemetry().count("serving.shed")
 
     def _fanout(self, req: ServeRequest) -> None:
@@ -858,6 +870,20 @@ class ContinuousScheduler:
                 return did
             if req.done:  # already shed/settled
                 continue
+            rt = get_reqtrace()
+            if rt.enabled:
+                # Slot claim closes the wait phase: ``queue`` for a fresh
+                # admit, ``gap.preempt`` for a preemption victim coming
+                # back (the visible hole preemption punched).
+                tt = req.meta.get("trace_t")
+                if tt is not None:
+                    name = (
+                        "gap.preempt" if tt.pop("preempted_at", None)
+                        else "queue"
+                    )
+                    now_w = time.time()
+                    rt.phase(req, name, tt.get("cursor"), now_w, slot=free)
+                    tt["cursor"] = now_w
             # A re-admitted request with a live checkpoint (preempted
             # victim, or a failed/replayed id resubmitted) skips tokenize,
             # page mapping, and every prefill chunk: O(1) resume.
@@ -966,6 +992,20 @@ class ContinuousScheduler:
         victim.req.meta["preempted"] = (
             victim.req.meta.get("preempted", 0) + 1
         )
+        rt = get_reqtrace()
+        if rt.enabled:
+            # Close the victim's running phase at the steal and mark the
+            # hole so re-admission names it ``gap.preempt``; preempted
+            # traces always flush (tail sampling).
+            now_w = rt.advance(
+                victim.req,
+                "prefill" if victim.t_first is None else "decode",
+                slot=idx, steps=victim.steps, preempted=True,
+            )
+            tt = victim.req.meta.get("trace_t")
+            if tt is not None and now_w is not None:
+                tt["preempted_at"] = now_w
+            rt.keep(victim.req, "preempted")
         with self._cond:
             self._queue.requeue(victim.req)
         self._free([idx])
@@ -1253,12 +1293,14 @@ class ContinuousScheduler:
         import jax
 
         tel = get_telemetry()
+        rt = get_reqtrace()
         did = False
         finishing = []  # (idx, slot, first_token_device_array)
         for idx, slot in enumerate(self._slots):
             if slot is None or slot.next_chunk < 0:
                 continue
             did = True
+            rt_t0 = time.time() if rt.enabled else None
             try:
                 with watchdog.watch("decode.dispatch", kind="decode"):
                     caches, first, is_last = self._retry.call(
@@ -1276,6 +1318,14 @@ class ContinuousScheduler:
                 continue
             self.caches = caches
             self._bump(prefill_dispatches=1)
+            if rt.enabled:
+                # Overlapping detail (never in the attribution sum): one
+                # span per prefill chunk dispatch.
+                rt.detail(
+                    slot.req, "prefill.chunk", rt_t0, time.time(),
+                    slot=idx,
+                    chunk=slot.next_chunk // self.plan.prefill_chunk,
+                )
             if is_last:
                 finishing.append((idx, slot, first))
             else:
@@ -1288,17 +1338,31 @@ class ContinuousScheduler:
                     self._adopt(slot)
                 slot.t_first = time.monotonic()
                 ttft = slot.t_first - slot.req.t_enqueue
+                ttft_miss = (
+                    self.ttft_slo_ms > 0.0
+                    and ttft * 1000.0 > self.ttft_slo_ms
+                )
                 self._ttft.observe(ttft)
                 with self._stats_lock:
                     self._ttft_ewma_s = (
                         ttft if self._ttft_ewma_s == 0.0
                         else 0.8 * self._ttft_ewma_s + 0.2 * ttft
                     )
-                    if (self.ttft_slo_ms > 0.0
-                            and ttft * 1000.0 > self.ttft_slo_ms):
+                    if ttft_miss:
                         self._stats["ttft_slo_misses"] += 1
                 tel.observe("serving.ttft_seconds", ttft,
                             buckets=_LATENCY_BUCKETS)
+                if rt.enabled:
+                    rt.advance(
+                        slot.req, "prefill", slot=idx,
+                        chunks=len(self.runtime.prompt_chunks(slot.plen))
+                        - slot.skipped,
+                        chunks_skipped=slot.skipped,
+                        kv_shared=slot.kv_shared,
+                        pages=len(slot.pages or ()),
+                    )
+                    if ttft_miss:
+                        rt.keep(slot.req, "ttft_slo_miss")
                 slot.carry = int(first)
                 if slot.carry == self.runtime.eos_id:
                     # The model's very first token is EOS: empty
@@ -1492,6 +1556,12 @@ class ContinuousScheduler:
                 rates.append(rate)
                 drafted_total += len(d)
                 accepted_total += acc
+                tt = s.req.meta.get("trace_t")
+                if tt is not None:
+                    # Per-request speculation outcome (settle attaches it
+                    # to the decode phase's attributes).
+                    tt["spec_drafted"] = tt.get("spec_drafted", 0) + len(d)
+                    tt["spec_accepted"] = tt.get("spec_accepted", 0) + acc
             committed += emit_n
             saw_eos = eos in emitted
             if saw_eos:
@@ -1510,6 +1580,7 @@ class ContinuousScheduler:
             for rate in rates:
                 self._accept_hist.observe(rate)
             self._block_hist.observe(committed / len(occupied))
+        self._rates["tokens_s"].mark(committed)
         tel.observe("serving.slot_occupancy", occ,
                     buckets=_OCCUPANCY_BUCKETS)
         if self.checkpoint_interval > 0:
@@ -1576,6 +1647,7 @@ class ContinuousScheduler:
         tel.observe("serving.slot_occupancy", occ,
                     buckets=_OCCUPANCY_BUCKETS)
         freed: List[int] = []
+        emitted_total = 0
         for i, s in occupied:
             emitted_n = int(steps_out[i]) - s.steps
             s.tokens.extend(int(t) for t in emitted[:emitted_n, i])
@@ -1583,10 +1655,12 @@ class ContinuousScheduler:
             s.carry = int(tok_out[i])
             s.done = bool(done_out[i])
             s.hist = None  # draft cache is stale once the carry moved
+            emitted_total += emitted_n
             self._bump(tokens_generated=emitted_n)
             saw_eos = emitted_n > 0 and self.runtime.eos_id in s.tokens[-emitted_n:]
             if saw_eos or s.steps >= s.budget:
                 freed.append(i)
+        self._rates["tokens_s"].mark(emitted_total)
         # Periodic checkpoint tick: refresh still-running slots so a
         # later failure loses at most ``checkpoint_interval`` dispatches
         # of work — a resubmitted id resumes from here, not the prompt.
@@ -1614,19 +1688,37 @@ class ContinuousScheduler:
         toks = toks[:slot.budget]
         text = self.backend.tokenizer.decode(toks)
         now = time.monotonic()
+        tpot_miss = False
         if slot.t_first is not None and len(toks) > 1:
             tpot = (now - slot.t_first) / (len(toks) - 1)
+            tpot_miss = (
+                self.tpot_slo_ms > 0.0 and tpot * 1000.0 > self.tpot_slo_ms
+            )
             self._tpot.observe(tpot)
             with self._stats_lock:
                 self._tpot_ewma_s = (
                     tpot if self._tpot_ewma_s == 0.0
                     else 0.8 * self._tpot_ewma_s + 0.2 * tpot
                 )
-                if (self.tpot_slo_ms > 0.0
-                        and tpot * 1000.0 > self.tpot_slo_ms):
+                if tpot_miss:
                     self._stats["tpot_slo_misses"] += 1
             tel.observe("serving.tpot_seconds", tpot,
                         buckets=_TOKEN_BUCKETS)
+        rt = get_reqtrace()
+        if rt.enabled:
+            # Close the decode phase BEFORE succeed() stamps the settle
+            # clock (the complete() hook), so the cursor partition stays
+            # contiguous: ... decode | commit | reply.
+            tt = slot.req.meta.get("trace_t") or {}
+            attrs: Dict[str, Any] = {
+                "slot": idx, "tokens": len(toks), "steps": slot.steps,
+            }
+            if "spec_drafted" in tt:
+                attrs["spec_drafted"] = tt["spec_drafted"]
+                attrs["spec_accepted"] = tt.get("spec_accepted", 0)
+            rt.advance(slot.req, "decode", **attrs)
+            if tpot_miss:
+                rt.keep(slot.req, "tpot_slo_miss")
         slot.req.succeed(
             text=text,
             label=normalise_label(text) if text.strip() else "Neutral",
@@ -1753,6 +1845,12 @@ class ContinuousScheduler:
             kv_backend="paged" if self.paged else "slots",
             checkpoint_interval=self.checkpoint_interval,
             checkpoints_live=len(self._ckpts),
+            rates={
+                "window_s": self._rates["req_s"].tau_s,
+                "req_s": self._rates["req_s"].rate(),
+                "tokens_s": self._rates["tokens_s"].rate(),
+                "shed_s": self._rates["shed_s"].rate(),
+            },
         )
         out["ttft_ewma_ms"] = round(self._ttft_ewma_s * 1000.0, 3)
         out["tpot_ewma_ms"] = round(self._tpot_ewma_s * 1000.0, 3)

@@ -70,8 +70,12 @@ from music_analyst_tpu.serving.batcher import (
     resolve_tp,
     resolve_ttft_slo_ms,
 )
-from music_analyst_tpu.serving.slo import FairQueue, TokenBucket
+from music_analyst_tpu.serving.slo import FairQueue, RateMeter, TokenBucket
 from music_analyst_tpu.telemetry import get_telemetry
+from music_analyst_tpu.telemetry.reqtrace import (
+    configure_reqtrace,
+    get_reqtrace,
+)
 
 # Ops the router will forward; anything else is a bad_request at the edge
 # (control ops never reach here — the front server answers them itself).
@@ -244,6 +248,11 @@ class ReplicaHandle:
                     self.last_stats = payload.get("stats")
                     continue
                 payload["id"] = original_id
+                rt = get_reqtrace()
+                if rt.enabled:
+                    # The worker answered: close the cross-process phase
+                    # (its own record details what happened over there).
+                    rt.advance(req, "downstream", replica=self.name)
                 req.complete(payload)
                 on_reply = self._on_reply
                 if on_reply is not None:
@@ -362,6 +371,9 @@ class ReplicaRouter:
         }
         self._tenants: Dict[str, Dict[str, int]] = {}
         self._transitions: List[Dict[str, Any]] = []
+        # Rolling-window rates (serving/slo.py RateMeter) for live
+        # ``stats`` polls — fleet req/s and shed/s without client deltas.
+        self._rates = {"req_s": RateMeter(), "shed_s": RateMeter()}
         self._started_mono = time.monotonic()
         # Per-replica respawn backoff: name -> [not_before_t, backoff_s].
         self._respawn_state: Dict[str, List[float]] = {}
@@ -438,6 +450,8 @@ class ReplicaRouter:
             ),
             deadline_ms=deadline_ms,
         )
+        # Trace attach BEFORE the shed ladder: sheds carry trace ids too.
+        get_reqtrace().begin_request(req)
         if op not in _FORWARD_OPS:
             req.fail("bad_request",
                      f"unknown op {op!r}; have: {sorted(_FORWARD_OPS)}")
@@ -510,6 +524,7 @@ class ReplicaRouter:
             self._tenant_ledger(req.tenant)["admitted"] += 1
             if depth > self._stats["queue_depth_max"]:
                 self._stats["queue_depth_max"] = depth
+        self._rates["req_s"].mark()
         tel.count("router.admitted")
         tel.gauge("router.queue_depth", depth)
         return req
@@ -532,6 +547,7 @@ class ReplicaRouter:
             if hint_ms is not None:
                 self._stats["retry_after_ms_last"] = hint_ms
             self._tenant_ledger(req.tenant)["shed"] += 1
+        self._rates["shed_s"].mark()
         get_telemetry().count("router.shed")
 
     def _settle_rate(self) -> float:
@@ -598,6 +614,12 @@ class ReplicaRouter:
             payload["tenant"] = req.tenant
         if req.priority != self.default_priority:
             payload["priority"] = req.priority
+        # Trace continuation downstream: the worker adopts the trace id
+        # and names the router's span as its parent (absent when tracing
+        # is off — ndjson/v1 unchanged).
+        trace = req.meta.get("trace")
+        if trace is not None:
+            payload["trace"] = {"id": trace["id"], "span": trace["span"]}
         return payload
 
     def _send_once(self, handle: ReplicaHandle, req: ServeRequest) -> None:
@@ -649,6 +671,12 @@ class ReplicaRouter:
                 return
             handle.dispatched += 1
             self._bump(dispatched=1)
+            rt = get_reqtrace()
+            if rt.enabled:
+                # The router-side wait ends at the downstream write; the
+                # worker's reply closes the ``downstream`` phase.
+                rt.advance(req, "queue", replica=handle.name,
+                           hops=req.meta.get("router_attempts", 0))
             tel.count("router.dispatched")
             return
 
@@ -711,6 +739,12 @@ class ReplicaRouter:
             # request's whole journey instead of naming only the last hop.
             hops = req.meta.setdefault("router_hops", [])
             hops.append({"replica": handle.name, "kind": kind})
+            rt = get_reqtrace()
+            if rt.enabled:
+                # The hop that died: requeued traces always flush.
+                rt.advance(req, "hop.requeue", replica=handle.name,
+                           kind=kind, hops=attempts)
+                rt.keep(req, "requeued")
             if attempts > self.redispatch_limit:
                 hint_ms = self.retry_after_ms()
                 req.fail(
@@ -858,6 +892,11 @@ class ReplicaRouter:
             ),
             max_queue=self.max_queue,
             settle_rate_req_s=round(self._settle_rate(), 3),
+            rates={
+                "window_s": self._rates["req_s"].tau_s,
+                "req_s": self._rates["req_s"].rate(),
+                "shed_s": self._rates["shed_s"].rate(),
+            },
             health_transitions=transitions,
             replicas={h.name: h.snapshot() for h in self.replicas},
         )
@@ -914,6 +953,7 @@ def _replica_cmd(
     tenant_budget: Optional[float] = None,
     priority: Optional[int] = None,
     journal_dir: Optional[str] = None,
+    trace_sample: Optional[float] = None,
 ) -> List[str]:
     cmd = [
         sys.executable, "-m", "music_analyst_tpu", "serve",
@@ -940,6 +980,10 @@ def _replica_cmd(
         ("--tenant-budget", tenant_budget),
         ("--priority", priority),
         ("--journal-dir", journal_dir),
+        # Workers inherit $MUSICAAL_TRACE_DIR from the router's
+        # configure_reqtrace; the explicit sample keeps the fleet's
+        # head-sampling decision identical even if the env is scrubbed.
+        ("--trace-sample", trace_sample),
     ):
         if value is not None:
             cmd += [flag, str(value)]
@@ -972,6 +1016,7 @@ def spawn_replicas(
     tenant_budget: Optional[float] = None,
     priority: Optional[int] = None,
     journal_dir: Optional[str] = None,
+    trace_sample: Optional[float] = None,
 ) -> List[ReplicaHandle]:
     """Start ``n`` worker server processes and (optionally) connect.
 
@@ -1003,6 +1048,7 @@ def spawn_replicas(
                 ttft_slo_ms=ttft_slo_ms, tpot_slo_ms=tpot_slo_ms,
                 tenant_budget=tenant_budget, priority=priority,
                 journal_dir=replica_journal,
+                trace_sample=trace_sample,
             )
             proc = subprocess.Popen(
                 cmd,
@@ -1049,6 +1095,8 @@ def run_router(
     tenant_budget: Optional[float] = None,
     priority: Optional[int] = None,
     journal_dir: Optional[str] = None,
+    trace_sample: Optional[Any] = None,
+    trace_dir: Optional[str] = None,
 ) -> int:
     """``serve --replicas N`` (N > 1): spawn the fleet, route until
     drained.  The front end is a stock ``SentimentServer`` with the
@@ -1068,6 +1116,12 @@ def run_router(
     # per-replica subdirectories; workers inherit the env, and without an
     # explicit per-worker flag they would all journal into the same dir.
     journal_base = resolve_journal_dir(journal_dir)
+    # Configure tracing BEFORE the fleet spawns: configure_reqtrace
+    # exports the resolved dir/sample to the environment, which is how
+    # workers (spawned without --profile-dir) join the same trace files.
+    reqtrace = configure_reqtrace(
+        trace_sample, directory=trace_dir, role="router"
+    )
     with tel.run_scope("serve", None):
         with tempfile.TemporaryDirectory(prefix="musicaal-fleet-") as base:
             handles = spawn_replicas(
@@ -1080,6 +1134,9 @@ def run_router(
                 ttft_slo_ms=ttft_slo_ms, tpot_slo_ms=tpot_slo_ms,
                 tenant_budget=tenant_budget, priority=priority,
                 journal_dir=journal_base,
+                trace_sample=(
+                    reqtrace.sample if reqtrace.enabled else None
+                ),
             )
             router = ReplicaRouter(
                 handles, max_queue=max_queue, ttft_slo_ms=ttft_slo_ms,
@@ -1131,6 +1188,7 @@ def run_router(
                         signal.signal(signum, prev)
                     except (ValueError, OSError):
                         pass
+                reqtrace.close()
                 stats = router.stats()
                 tel.gauge("router.requests_total", stats["admitted"])
                 tel.gauge("router.requeued_total", stats["requeued"])

@@ -66,6 +66,10 @@ from music_analyst_tpu.serving.journal import (
 )
 from music_analyst_tpu.serving.residency import ModelResidency
 from music_analyst_tpu.telemetry import get_telemetry
+from music_analyst_tpu.telemetry.reqtrace import (
+    configure_reqtrace,
+    get_reqtrace,
+)
 
 PROTOCOL = "ndjson/v1"
 
@@ -213,6 +217,7 @@ class SentimentServer:
     def _parse_submit(self, line: str) -> ServeRequest:
         """One wire line → an admitted/settled ServeRequest (parse errors
         settle immediately as ``bad_request`` so ordering still holds)."""
+        t0_w = time.time()
         self._auto_ids += 1
         fallback_id = f"auto-{self._auto_ids}"
         try:
@@ -281,12 +286,26 @@ class SentimentServer:
                 deduped["id"] = rid
                 req.complete(deduped)
                 return req
+        rt = get_reqtrace()
+        trace = None
+        if rt.enabled:
+            # Adopt the wire's optional "trace" field (absent ⇒ new
+            # root: ndjson/v1 stays backward-compatible) and hand it to
+            # the submit below on this same thread, clocked from the
+            # moment the line arrived.
+            trace = rt.mint(payload.get("trace"))
+            rt.set_pending(trace, t0_w)
+        if self.journal is not None:
+            meta: Dict[str, Any] = {}
+            if budget is not None:
+                meta["max_new_tokens"] = budget
+            if trace is not None:
+                # Crash replay re-adopts the same trace id, so the
+                # waterfall survives a restart (_replay_journal).
+                meta["trace"] = trace
             self.journal.record_admitted(
                 rid, op, text, tenant=tenant, priority=priority,
-                deadline_ms=deadline_ms,
-                meta=(
-                    {"max_new_tokens": budget} if budget is not None else {}
-                ),
+                deadline_ms=deadline_ms, meta=meta,
             )
         # Post-admit crash seam: admission journaled, no reply yet — a
         # SIGKILL here must replay the request on restart.
@@ -306,6 +325,7 @@ class SentimentServer:
         reply in order.  Returns the number of replies written.
         """
         tel = get_telemetry()
+        rt = get_reqtrace()
         order: "queue.Queue" = queue.Queue()
         stop_reading = threading.Event()
 
@@ -382,6 +402,7 @@ class SentimentServer:
             while pending and pending[0].done:
                 batch.append(pending.popleft())
             journaled = False
+            t_sync0 = time.time() if rt.enabled else None
             for settled in batch:
                 # Pre-reply crash seam, then the durability barrier.
                 fault_point("serve.reply", op=settled.op)
@@ -394,10 +415,31 @@ class SentimentServer:
                     journaled = True
             if journaled:
                 self.journal.sync()
+            if rt.enabled:
+                # The group-commit barrier is shared: every settled
+                # request's ``commit`` phase runs settle → barrier end,
+                # with the fsync itself an overlapping detail span.
+                t_sync1 = time.time()
+                for settled in batch:
+                    tt = settled.meta.get("trace_t")
+                    if tt is None:
+                        continue
+                    rt.phase(settled, "commit",
+                             tt.get("cursor", t_sync0), t_sync1,
+                             journaled=journaled, group=len(batch))
+                    if journaled:
+                        rt.detail(settled, "journal.sync",
+                                  t_sync0, t_sync1)
+                    tt["cursor"] = t_sync1
             for settled in batch:
+                if rt.enabled:
+                    rt.annotate_reply(settled)
                 with tel.span("serve.reply", op=settled.op):
                     wfile.write(json.dumps(settled.response) + "\n")
                     wfile.flush()
+                if rt.enabled:
+                    rt.advance(settled, "reply", op=settled.op)
+                    rt.finish_request(settled)
                 written += 1
         stop_reading.set()
         return written
@@ -474,6 +516,9 @@ class SentimentServer:
             out["router"] = self.router.stats()
         if self.journal is not None:
             out["journal"] = self.journal.stats()
+        rt = get_reqtrace()
+        if rt.enabled:
+            out["reqtrace"] = rt.stats()
         # SLO layer (serving/slo.py) — only-when-used, like the
         # corpus-cache manifest section: empty snapshots stay out.
         slo: Dict[str, Any] = {}
@@ -503,12 +548,18 @@ def _replay_journal(journal: RequestJournal, batcher, decode,
     index."""
     if not unanswered:
         return 0
+    rt = get_reqtrace()
     reqs: List[ServeRequest] = []
     for record in unanswered:
         rid = record.get("id")
         op = record.get("op")
         text = record.get("text") or ""
         meta = record.get("meta") or {}
+        if rt.enabled and isinstance(meta.get("trace"), dict):
+            # Continue the journaled trace (same id; the crashed
+            # process's span becomes the parent) so the waterfall spans
+            # the restart.
+            rt.set_pending(rt.mint(meta["trace"]), time.time())
         slo = dict(
             tenant=record.get("tenant"),
             priority=record.get("priority"),
@@ -599,6 +650,8 @@ def run_server(
     tenant_budget: Optional[float] = None,
     priority: Optional[int] = None,
     journal_dir: Optional[str] = None,
+    trace_sample: Optional[Any] = None,
+    trace_dir: Optional[str] = None,
 ) -> int:
     """The ``serve`` subcommand: load, warm, then serve until drained.
 
@@ -606,6 +659,12 @@ def run_server(
     the reply channel and must carry nothing but NDJSON responses.
     """
     tel = get_telemetry()
+    # Request tracing (telemetry/reqtrace.py): enabled iff a directory
+    # resolves (--profile-dir here, $MUSICAAL_TRACE_DIR in replica
+    # workers the router spawned).  Disabled = inert.
+    reqtrace = configure_reqtrace(
+        trace_sample, directory=trace_dir, role="server"
+    )
     resolved_batch = resolve_max_batch(max_batch)
     with tel.run_scope("serve", None):
         # Crash-consistency first: open the journal (replaying its state)
@@ -776,6 +835,8 @@ def run_server(
             # the next start detects it.
             if journal is not None:
                 journal.close()
+            # Kept traces become the Chrome artifact exactly once.
+            reqtrace.close()
             stats = server.stats_snapshot()
             tel.gauge("serving.requests_total",
                       stats["requests"]["admitted"])

@@ -31,10 +31,44 @@ it must stay importable before the test harness pins ``JAX_PLATFORMS``.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
+
+
+class RateMeter:
+    """Rolling-window event rate: a time-decayed accumulator with a
+    ~``tau_s`` horizon, so a live ``stats`` poller reads req/s, tokens/s
+    and shed/s directly instead of differencing cumulative counters.
+
+    ``mark(n)`` decays the accumulator by ``exp(-dt/tau)`` then adds
+    ``n``; at a steady arrival rate ``r`` the accumulator converges to
+    ``r * tau``, so ``rate() = acc / tau`` reads the sustained rate and
+    forgets a burst within a few windows.  An idle meter costs nothing
+    (decay happens lazily on access).
+    """
+
+    def __init__(self, tau_s: float = 10.0) -> None:
+        self.tau_s = float(tau_s)
+        self._acc = 0.0
+        self._t_last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def mark(self, n: float = 1.0) -> None:
+        with self._lock:
+            now = time.monotonic()
+            self._acc *= math.exp(-(now - self._t_last) / self.tau_s)
+            self._t_last = now
+            self._acc += float(n)
+
+    def rate(self) -> float:
+        """Events per second over the rolling window."""
+        with self._lock:
+            now = time.monotonic()
+            acc = self._acc * math.exp(-(now - self._t_last) / self.tau_s)
+        return round(acc / self.tau_s, 6)
 
 
 class TokenBucket:

@@ -249,6 +249,20 @@ def write_run_manifest(
     except Exception:
         pass
     try:
+        # Request-trace recorder digest + tail exemplars: quantile trace
+        # ids a reader can resolve against request_traces.jsonl — only
+        # when tracing was enabled, so untraced runs keep the key set.
+        from music_analyst_tpu.telemetry.reqtrace import get_reqtrace
+
+        rt = get_reqtrace()
+        if rt.enabled:
+            manifest["reqtrace"] = rt.stats()
+            exemplars = rt.exemplars()
+            if exemplars:
+                manifest["trace_exemplars"] = exemplars
+    except Exception:
+        pass
+    try:
         # Watchdog verdicts + flight-record pointer — only when there is
         # something to say, so unwatched runs keep the original key set.
         from music_analyst_tpu.observability.flight import get_flight_recorder

@@ -74,6 +74,45 @@ print("crash-recovery self-check ok:",
 endef
 export CRASH_SELFCHECK
 
+# Burn-rate self-check body (exported below; run with $(PY) -c
+# "$$BURN_SELFCHECK" <burst-dir> <steady-dir>): the burst run's
+# metrics.jsonl must hold EXACTLY ONE firing burn-rate alert — the bulk
+# tenant's shed burn — whose trace_id resolves to a kept shed exemplar
+# in the same run's request_traces.jsonl; the steady run must sample
+# but stay silent.
+define BURN_SELFCHECK
+import json, sys
+burst, steady = sys.argv[1], sys.argv[2]
+
+def load(path):
+    recs = [json.loads(l) for l in open(path) if l.strip()]
+    return ([r for r in recs if r.get("type") == "sample"],
+            [r for r in recs if r.get("type") == "alert"])
+
+samples, alerts = load(burst + "/metrics.jsonl")
+assert len(samples) >= 2, f"burst run took {len(samples)} sample(s)"
+firing = [a for a in alerts if a["state"] == "firing"]
+assert len(firing) == 1, [a.get("alert") for a in firing]
+alert = firing[0]
+assert alert["alert"] == "shed_burn_rate", alert
+assert alert["tenant"] == "bulk", alert
+assert alert["burn_fast"] >= alert["threshold"], alert
+tid = alert.get("trace_id")
+assert isinstance(tid, str) and tid, f"alert carries no trace_id: {alert}"
+traces = [json.loads(l)
+          for l in open(burst + "/request_traces.jsonl") if l.strip()]
+assert any(t.get("trace_id") == tid for t in traces), \
+    f"alert trace_id {tid} not kept in request_traces.jsonl"
+s_samples, s_alerts = load(steady + "/metrics.jsonl")
+assert len(s_samples) >= 2, f"steady run took {len(s_samples)} sample(s)"
+s_firing = [a for a in s_alerts if a["state"] == "firing"]
+assert not s_firing, s_firing
+print("burn-rate self-check ok: shed_burn_rate tenant=bulk,",
+      "burn %.0fx/%.0fx," % (alert["burn_fast"], alert["burn_slow"]),
+      "trace", tid, "kept, steady run silent")
+endef
+export BURN_SELFCHECK
+
 # Fast observability gate: profiling + telemetry + pipeline +
 # observability + corpus-cache/streaming unit tests, then one
 # smoke-shaped bench.py run through the full parent/child/--baseline
@@ -92,7 +131,7 @@ smoke:
 		tests/test_resilience.py tests/test_continuous.py \
 		tests/test_kv_pages.py tests/test_router.py \
 		tests/test_journal.py tests/test_speculative.py \
-		tests/test_reqtrace.py -q
+		tests/test_reqtrace.py tests/test_metrics_plane.py -q
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= MUSICAAL_BENCH_SMOKE=1 \
 		$(PY) bench.py --baseline --attempts 1 --deadline 240 \
 		| $(PY) -c "import json,sys; \
@@ -353,6 +392,59 @@ print('smoke ok:', payload['metric'], payload['value'])"
 	      len(sheds), 'structured shed(s)')" \
 		"$$overtmp/replies.ndjson" || \
 		{ echo "overload self-check failed"; exit 1; }
+	# burn-rate self-check (body in BURN_SELFCHECK above): the overload
+	# burst replayed through a journaled, metered stdio server — the bulk
+	# flood past its 1 req/s budget must fire exactly one burn-rate alert
+	# whose trace_id resolves to a kept shed exemplar; a within-budget
+	# steady run on the same flags must sample but fire zero.  The 200ms
+	# interval makes the sample set deterministic (baseline + close-time
+	# final, after every reply and kept trace has flushed).
+	burntmp=$$(mktemp -d) && trap 'rm -rf "$$burntmp"' EXIT && \
+	{ for i in 0 1 2 3 4 5 6 7 8 9; do \
+		printf '{"id":"b%s","op":"sentiment","text":"bulk row %s","tenant":"bulk","priority":1}\n' "$$i" "$$i"; \
+	done; \
+	printf '%s\n' \
+		'{"id":"gold","op":"sentiment","text":"I love this happy day","tenant":"gold","priority":5}'; } | \
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+		$(PY) -m music_analyst_tpu serve --stdio --mock --quiet \
+		--max-batch 4 --max-wait-ms 2 --max-queue 8 \
+		--tenant-budget 1 --ttft-slo-ms 5000 \
+		--journal-dir "$$burntmp/journal" --trace-sample 0 \
+		--metrics-interval-ms 200 --profile-dir "$$burntmp/burst" \
+		> "$$burntmp/burst.ndjson" || { echo "burn-rate burst run failed"; exit 1; }; \
+	printf '%s\n' \
+		'{"id":"c1","op":"sentiment","text":"calm seas","tenant":"bulk","priority":1}' \
+		'{"id":"c2","op":"sentiment","text":"steady light","tenant":"gold","priority":5}' | \
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+		$(PY) -m music_analyst_tpu serve --stdio --mock --quiet \
+		--max-batch 4 --max-wait-ms 2 --max-queue 8 \
+		--tenant-budget 1 --ttft-slo-ms 5000 \
+		--journal-dir "$$burntmp/journal2" --trace-sample 0 \
+		--metrics-interval-ms 200 --profile-dir "$$burntmp/steady" \
+		> "$$burntmp/steady.ndjson" || { echo "burn-rate steady run failed"; exit 1; }; \
+	$(PY) -c "$$BURN_SELFCHECK" "$$burntmp/burst" "$$burntmp/steady" || \
+		{ echo "burn-rate self-check failed"; exit 1; }
+	# live-monitor self-check: serve on a unix socket in the background,
+	# wait for the socket to appear, and assert the jax-free
+	# `monitor --once` renders a healthy snapshot (exit 0) against the
+	# live front end.
+	montmp=$$(mktemp -d) && trap 'rm -rf "$$montmp"' EXIT && \
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+		$(PY) -m music_analyst_tpu serve --socket "$$montmp/sock" \
+		--mock --quiet --max-batch 4 --max-wait-ms 2 \
+		--metrics-interval-ms 200 --profile-dir "$$montmp" & \
+	srvpid=$$!; \
+	tries=0; \
+	while [ ! -S "$$montmp/sock" ] && [ $$tries -lt 100 ]; do \
+		sleep 0.1; tries=$$((tries + 1)); \
+	done; \
+	[ -S "$$montmp/sock" ] || { kill $$srvpid 2>/dev/null; \
+		echo "monitor self-check: socket never appeared"; exit 1; }; \
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+		$(PY) -m music_analyst_tpu monitor --once --socket "$$montmp/sock" || \
+		{ kill $$srvpid 2>/dev/null; echo "monitor self-check failed"; exit 1; }; \
+	kill $$srvpid 2>/dev/null; wait $$srvpid 2>/dev/null; \
+	echo "monitor self-check ok"
 
 test:
 	$(PY) -m pytest tests/ -q

@@ -438,6 +438,116 @@ def _reqtrace_flush_scenario(n_requests: int) -> dict:
     }
 
 
+def _metrics_scrape_scenario(n_requests: int) -> dict:
+    """Injected scrape failure (site ``metrics.scrape``): every scrape
+    attempt trips, so the series degrades to a stale-marked plane with
+    counted ``scrape_errors`` — the replies are byte-identical to the
+    clean metered run, and no torn ``metrics.jsonl`` line ever lands
+    (a failed scrape writes nothing at all).  Observability must never
+    block — or bend — the reply path."""
+    from music_analyst_tpu.observability.metrics_plane import (
+        METRICS_FILE,
+        configure_metrics,
+    )
+    from music_analyst_tpu.resilience import configure_faults, fault_stats
+    from music_analyst_tpu.serving.batcher import DynamicBatcher
+
+    ops = {"echo": lambda texts: [{"label": t.upper()} for t in texts]}
+
+    def _run(tag: str, out_dir: str):
+        plane = configure_metrics(25.0, directory=out_dir, role="bench")
+        batcher = DynamicBatcher(
+            ops, max_batch=8, max_wait_ms=1.0, max_queue=n_requests + 1
+        ).start()
+        plane.attach(lambda: {
+            "requests": batcher.stats(), "slo": batcher.slo_snapshot(),
+        })
+        plane.start()
+        try:
+            reqs = [
+                batcher.submit(f"{tag}-{i}", "echo", f"chaos row {i}")
+                for i in range(n_requests)
+            ]
+            for req in reqs:
+                if not req.wait(timeout=60.0):
+                    raise RuntimeError(f"request {req.id} never settled")
+        finally:
+            batcher.drain()
+            plane.close()
+        labels = [(r.response or {}).get("label") for r in reqs]
+        return labels, plane.snapshot()
+
+    def _jsonl_intact(path: str):
+        """(intact, n_lines): every line newline-terminated and parseable
+        — the O_APPEND single-write discipline's observable contract."""
+        if not os.path.exists(path):
+            return True, 0
+        n = 0
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                if not line.endswith("\n"):
+                    return False, n
+                try:
+                    json.loads(line)
+                except json.JSONDecodeError:
+                    return False, n
+                n += 1
+        return True, n
+
+    try:
+        with tempfile.TemporaryDirectory(prefix="chaos_metrics_") as base:
+            clean_dir = os.path.join(base, "clean")
+            faulted_dir = os.path.join(base, "faulted")
+            start = time.perf_counter()
+            clean_labels, clean_snap = _run("clean", clean_dir)
+            configure_faults("metrics.scrape:error@1+")
+            try:
+                faulted_labels, faulted_snap = _run("faulted", faulted_dir)
+                trips = fault_stats()["metrics.scrape"]["trips"]
+            finally:
+                configure_faults(None)
+            elapsed = time.perf_counter() - start
+            clean_intact, clean_lines = _jsonl_intact(
+                os.path.join(clean_dir, METRICS_FILE)
+            )
+            faulted_intact, faulted_lines = _jsonl_intact(
+                os.path.join(faulted_dir, METRICS_FILE)
+            )
+    finally:
+        # configure_metrics exported the interval/dir env for worker
+        # inheritance — clear them so the disabled plane stays off.
+        os.environ.pop("MUSICAAL_METRICS_INTERVAL_MS", None)
+        os.environ.pop("MUSICAAL_METRICS_DIR", None)
+        configure_metrics(None, None)
+    return {
+        "scenario": "metrics_scrape_fault",
+        "spec": "metrics.scrape:error@1+",
+        "requests": n_requests,
+        "bytes_identical": faulted_labels == clean_labels,
+        "all_answered": (
+            all(label is not None for label in faulted_labels)
+            and len(faulted_labels) == n_requests
+        ),
+        "samples_clean": clean_snap["samples"],
+        "scrape_errors": faulted_snap["scrape_errors"],
+        "trips": trips,
+        "clean_file_intact": clean_intact,
+        "clean_file_lines": clean_lines,
+        "faulted_file_lines": faulted_lines,
+        "degraded_to_stale": (
+            clean_snap["samples"] >= 2  # baseline + final at minimum
+            and clean_snap["scrape_errors"] == 0
+            and clean_intact and clean_lines >= clean_snap["samples"]
+            and faulted_snap["samples"] == 0
+            and faulted_snap["scrape_errors"] == trips
+            and trips > 0
+            and bool(faulted_snap["stale"])
+            and faulted_intact and faulted_lines == 0
+        ),
+        "wall_s": round(elapsed, 4),
+    }
+
+
 def _journal_scenario() -> dict:
     """Faulted appends + a torn segment tail (site ``journal.append``):
     the server-side append failure is absorbed (the request still
@@ -697,6 +807,15 @@ def run() -> dict:
             file=sys.stderr,
         )
 
+        metrics_scrape = _metrics_scrape_scenario(16 if smoke() else 128)
+        print(
+            f"[chaos] metrics_scrape: identical="
+            f"{metrics_scrape['bytes_identical']} "
+            f"scrape_errors={metrics_scrape['scrape_errors']} "
+            f"degraded={metrics_scrape['degraded_to_stale']}",
+            file=sys.stderr,
+        )
+
     reset_retry_stats()
     return {
         "suite": "chaos",
@@ -714,11 +833,13 @@ def run() -> dict:
         "preempt_fault": preempt,
         "journal_append": journal_wal,
         "reqtrace_flush": reqtrace_flush,
+        "metrics_scrape": metrics_scrape,
         "all_identical": all(
             s["bytes_identical"] for s in scenarios
         ) and prefix["bytes_identical"] and spec_draft["bytes_identical"]
         and preempt["bytes_identical"]
-        and reqtrace_flush["bytes_identical"],
+        and reqtrace_flush["bytes_identical"]
+        and metrics_scrape["bytes_identical"],
         "all_recovered": all(
             s["trips"] > 0
             and (s["degraded"] if s["expect_degraded"] else True)
@@ -729,5 +850,6 @@ def run() -> dict:
         and preempt["preempt_faults"] > 0
         and preempt["preemptions_faulted"] == 0
         and journal_wal["degraded_to_recompute"]
-        and reqtrace_flush["degraded_to_drops"],
+        and reqtrace_flush["degraded_to_drops"]
+        and metrics_scrape["degraded_to_stale"],
     }

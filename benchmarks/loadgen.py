@@ -158,6 +158,30 @@ def flash_crowd_arrivals(
     return out
 
 
+def offered_load_series(
+    arrivals: Sequence[Arrival],
+) -> List[Dict[str, Any]]:
+    """The trace's offered load as a per-second time series, broken down
+    by request class (``tenant/p<priority>``).  Computable up front —
+    the trace IS the offered load — so a run's measured fleet req/s can
+    be checked against exactly what was asked of it (the metrics plane's
+    ``requests.rates.req_s`` on the other side of the same second)."""
+    buckets: Dict[int, Dict[str, int]] = {}
+    for arrival in arrivals:
+        sec = int(arrival.t_s)
+        cls = f"{arrival.tenant}/p{arrival.priority}"
+        bucket = buckets.setdefault(sec, {})
+        bucket[cls] = bucket.get(cls, 0) + 1
+    return [
+        {
+            "t_s": sec,
+            "req_s": sum(classes.values()),
+            "classes": dict(sorted(classes.items())),
+        }
+        for sec, classes in sorted(buckets.items())
+    ]
+
+
 def _percentile(sorted_vals: List[float], q: float) -> float:
     """Nearest-rank percentile on an already-sorted list."""
     if not sorted_vals:
@@ -202,8 +226,10 @@ class LoadGen:
                 continue
             live.append((arrival, self.submit(i, arrival)))
         wall_s = time.monotonic() - t0
-        return self._report(live, len(events), ticks_faulted, wall_s,
-                            settle_timeout_s)
+        report = self._report(live, len(events), ticks_faulted, wall_s,
+                              settle_timeout_s)
+        report["offered_load"] = offered_load_series(events)
+        return report
 
     def _report(self, live: List[Tuple[Arrival, Any]], offered: int,
                 ticks_faulted: int, replay_wall_s: float,

@@ -411,7 +411,36 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                         "--profile-dir (default $MUSICAAL_TRACE_SAMPLE "
                         "or 0; requires --profile-dir or "
                         "$MUSICAAL_TRACE_DIR)")
+    p.add_argument("--metrics-interval-ms", default=None, metavar="MS",
+                   help="Metrics plane sampling interval in ms: every "
+                        "serving counter/gauge/histogram/rate snapshots "
+                        "into a ring-buffer time series, flushes to "
+                        "metrics.jsonl + a Prometheus exposition file "
+                        "under --profile-dir, and feeds multi-window SLO "
+                        "burn-rate alerts (default "
+                        "$MUSICAAL_METRICS_INTERVAL_MS or 0 = off)")
     _add_telemetry_flags(p)
+
+
+def _add_monitor(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "monitor",
+        help="live fleet monitor: attach to a serving socket and render "
+             "a refreshing per-replica table (req/s, tokens/s, "
+             "occupancy, queue depth, p50/p99, active burn-rate alerts); "
+             "jax-free (observability/monitor.py)",
+    )
+    p.add_argument("--socket", required=True, metavar="PATH",
+                   help="Unix socket of a live serve front end (single "
+                        "server or replica router)")
+    p.add_argument("--once", action="store_true",
+                   help="Render one snapshot and exit (0 = healthy "
+                        "reply, 1 = draining, 2 = no usable reply)")
+    p.add_argument("--interval", type=float, default=2.0, metavar="S",
+                   help="Refresh period in seconds (default 2.0)")
+    p.add_argument("--json", action="store_true",
+                   help="Emit each snapshot as one JSON object instead "
+                        "of the table")
 
 
 def _add_sweep(sub: argparse._SubParsersAction) -> None:
@@ -444,6 +473,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_profile_diff(sub)
     _add_telemetry_report(sub)
     _add_trace_report(sub)
+    _add_monitor(sub)
     args = parser.parse_args(argv)
 
     if args.command == "profile-diff":
@@ -471,6 +501,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         from music_analyst_tpu.observability.report import run_trace_report
 
         return run_trace_report(args.sources, json_output=args.json)
+
+    if args.command == "monitor":
+        # A live monitor must attach while the device is busy (or the
+        # tunnel dead): pure socket client, no telemetry scope, no jax.
+        from music_analyst_tpu.observability.monitor import run_monitor
+
+        return run_monitor(
+            args.socket, once=args.once, interval_s=args.interval,
+            json_output=args.json,
+        )
 
     from music_analyst_tpu.telemetry import configure
 
@@ -694,6 +734,7 @@ def _dispatch(parser: argparse.ArgumentParser,
                 journal_dir=args.journal_dir,
                 trace_sample=args.trace_sample,
                 trace_dir=args.profile_dir,
+                metrics_interval_ms=args.metrics_interval_ms,
             )
             if resolve_replicas(args.replicas) > 1:
                 from music_analyst_tpu.serving.router import run_router

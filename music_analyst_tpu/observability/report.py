@@ -178,6 +178,82 @@ def _scan_jsonl(path: str) -> Dict[str, Any]:
     }
 
 
+# Headline series the cross-run trajectory tracks (first→last per run).
+# These are the fleet-health numbers an operator graphs first; the full
+# series stays in metrics.jsonl for anything deeper.
+_METRICS_HEADLINES = (
+    "requests.rates.req_s",
+    "requests.rates.shed_s",
+    "decode.rates.tokens_s",
+    "requests.admitted",
+    "requests.shed",
+)
+
+# The alert-record fields worth carrying into the cross-run history.
+_ALERT_FIELDS = (
+    "alert", "state", "tenant", "t", "burn_fast", "burn_slow",
+    "threshold", "trace_id",
+)
+
+
+def _scan_metrics_jsonl(path: str) -> Dict[str, Any]:
+    """Single pass over a ``metrics.jsonl`` (observability/metrics_plane):
+    sample count + time span, first→last of each headline series, and
+    every burn-rate alert record."""
+    samples = 0
+    t_first: Optional[float] = None
+    t_last: Optional[float] = None
+    first: Dict[str, float] = {}
+    last: Dict[str, float] = {}
+    alerts: List[Dict[str, Any]] = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                if rec.get("type") == "alert":
+                    alerts.append(
+                        {k: rec.get(k) for k in _ALERT_FIELDS}
+                    )
+                    continue
+                if rec.get("type") != "sample":
+                    continue
+                samples += 1
+                t = rec.get("t")
+                if isinstance(t, (int, float)):
+                    t_first = t if t_first is None else t_first
+                    t_last = t
+                flat = rec.get("metrics") or {}
+                for key in _METRICS_HEADLINES:
+                    value = flat.get(key)
+                    if isinstance(value, (int, float)):
+                        first.setdefault(key, value)
+                        last[key] = value
+    except OSError:
+        return {"summary": None, "alerts": []}
+    summary: Optional[Dict[str, Any]] = None
+    if samples:
+        summary = {
+            "samples": samples,
+            "span_s": (
+                round(t_last - t_first, 6)
+                if t_first is not None and t_last is not None else None
+            ),
+            "series": {
+                key: {"first": first.get(key), "last": last[key]}
+                for key in last
+            },
+        }
+    return {"summary": summary, "alerts": alerts}
+
+
 def _dir_record(directory: str, label: str) -> Optional[Dict[str, Any]]:
     """A telemetry run dir: manifest + JSONL + optional flight record."""
     manifest_path = os.path.join(directory, "run_manifest.json")
@@ -250,6 +326,14 @@ def _dir_record(directory: str, label: str) -> Optional[Dict[str, Any]]:
         for key in ("faults", "retries", "recoveries", "failovers"):
             if scan[key]:
                 rec.setdefault("resilience_events", {})[key] = scan[key]
+    metrics_path = os.path.join(directory, "metrics.jsonl")
+    if os.path.exists(metrics_path):
+        found = True
+        scan = _scan_metrics_jsonl(metrics_path)
+        if scan["summary"]:
+            rec["metrics"] = scan["summary"]
+        if scan["alerts"]:
+            rec["alerts"] = scan["alerts"]
     if os.path.exists(flight_path):
         found = True
         try:
@@ -314,6 +398,8 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     degraded_runs: List[Dict[str, Any]] = []
     router_fleet: List[Dict[str, Any]] = []
     speculation_runs: List[Dict[str, Any]] = []
+    metrics_runs: List[Dict[str, Any]] = []
+    alert_history: List[Dict[str, Any]] = []
 
     def _site(site: str) -> Dict[str, int]:
         return resilience_sites.setdefault(
@@ -419,6 +505,13 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         spec = ((rec.get("serving") or {}).get("decode") or {}).get(
             "speculation"
         ) or {}
+        # Metrics-plane trajectory + burn-rate alert history (scanned
+        # from metrics.jsonl by _dir_record above).
+        metrics = rec.get("metrics")
+        if metrics:
+            metrics_runs.append({"label": rec["label"], **metrics})
+        for alert in rec.get("alerts") or []:
+            alert_history.append({"label": rec["label"], **alert})
         if spec.get("enabled"):
             speculation_runs.append({
                 "label": rec["label"],
@@ -471,6 +564,8 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "degraded_runs": degraded_runs,
         "router_fleet": router_fleet,
         "speculation": speculation,
+        "metrics_runs": metrics_runs,
+        "alert_history": alert_history,
         "newest": {
             "label": newest["label"],
             "ok": newest["ok"],
@@ -585,6 +680,38 @@ def render_report(report: Dict[str, Any]) -> List[str]:
                     f"p50={_num(quants['p50'])} p95={_num(quants['p95'])} "
                     f"max={_num(quants['max'])}"
                 )
+    if report.get("metrics_runs"):
+        lines.append("metrics plane (headline series, first -> last):")
+
+        def _mnum(value: Any) -> str:
+            return (f"{value:.2f}"
+                    if isinstance(value, (int, float)) else "-")
+
+        for run in report["metrics_runs"]:
+            span = run.get("span_s")
+            span_text = (f" over {span:.1f}s"
+                         if isinstance(span, (int, float)) else "")
+            lines.append(
+                f"  {run['label']}: {run['samples']} sample(s){span_text}"
+            )
+            for key, point in sorted((run.get("series") or {}).items()):
+                lines.append(
+                    f"    {key}: {_mnum(point.get('first'))} -> "
+                    f"{_mnum(point.get('last'))}"
+                )
+    if report.get("alert_history"):
+        lines.append("burn-rate alert history:")
+        for alert in report["alert_history"]:
+            tenant = (f" tenant={alert['tenant']}"
+                      if alert.get("tenant") else "")
+            trace = (f" trace={alert['trace_id']}"
+                     if alert.get("trace_id") else "")
+            lines.append(
+                f"  {alert['label']} {alert.get('alert')}{tenant}: "
+                f"{alert.get('state')} "
+                f"burn {alert.get('burn_fast')}x/{alert.get('burn_slow')}x "
+                f"(threshold {alert.get('threshold')}x){trace}"
+            )
     for run in report.get("degraded_runs") or []:
         lines.append(
             f"  DEGRADED {run['label']}: {run['site']} ({run['reason']})"
@@ -662,6 +789,33 @@ def _iter_trace_files(source: str) -> List[str]:
     if source.endswith(".jsonl") and os.path.exists(source):
         return [source]
     return []
+
+
+def _alert_trace_ids(source: str) -> List[str]:
+    """Trace ids named by burn-rate alert records in an alert file
+    (``metrics.jsonl``, or any JSONL of ``type == "alert"`` records from
+    observability/metrics_plane.py).  Directories, non-JSONL files, and
+    files without alert records return [] — they are trace sources, not
+    alert sources."""
+    if not os.path.isfile(source) or not source.endswith((".jsonl", ".json")):
+        return []
+    ids: List[str] = []
+    try:
+        with open(source, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if (isinstance(rec, dict) and rec.get("type") == "alert"
+                        and isinstance(rec.get("trace_id"), str)):
+                    ids.append(rec["trace_id"])
+    except OSError:
+        return []
+    return ids
 
 
 def load_trace_records(sources: List[str]) -> List[Dict[str, Any]]:
@@ -836,6 +990,12 @@ def render_trace_report(report: Dict[str, Any]) -> List[str]:
         f"({report['n_complete']} complete) from "
         f"{report['n_records']} process record(s)"
     ]
+    alert_filter = report.get("alert_filter")
+    if alert_filter:
+        lines.append(
+            f"alert filter: {alert_filter['n_alert_records']} alert "
+            f"record(s) -> {len(alert_filter['trace_ids'])} trace id(s)"
+        )
     if report["kept_reasons"]:
         shown = ", ".join(
             f"{k}={n}" for k, n in report["kept_reasons"].items()
@@ -900,18 +1060,46 @@ def render_trace_report(report: Dict[str, Any]) -> List[str]:
 def run_trace_report(sources: List[str], json_output: bool = False) -> int:
     """CLI entry.  Exit 0 = at least one complete waterfall, 1 = traces
     found but none complete, 2 = no usable input — the 0/1/2 gate
-    semantics telemetry-report and profile-diff already use."""
+    semantics telemetry-report and profile-diff already use.
+
+    A source holding burn-rate alert records (``metrics.jsonl``) is an
+    *alert* source: its named ``trace_id``s become a filter, and the
+    trace records are pulled from the alert file's own directory — so
+    "the pager fired" resolves straight to the breaching waterfalls.
+    """
     import sys
 
-    records = load_trace_records(sources)
+    alert_records = 0
+    wanted: set = set()
+    trace_sources: List[str] = []
+    for source in sources:
+        ids = _alert_trace_ids(source)
+        if ids:
+            alert_records += len(ids)
+            wanted.update(ids)
+            trace_sources.append(
+                os.path.dirname(os.path.abspath(source))
+            )
+        else:
+            trace_sources.append(source)
+    records = load_trace_records(trace_sources)
+    if wanted:
+        records = [r for r in records if r["trace_id"] in wanted]
     if not records:
         print(
             f"trace-report: no trace records among {len(sources)} "
-            "source(s) (expected request_traces*.jsonl lines)",
+            "source(s) (expected request_traces*.jsonl lines"
+            + (" matching the alert trace ids" if wanted else "")
+            + ")",
             file=sys.stderr,
         )
         return 2
     report = build_trace_report(records)
+    if wanted:
+        report["alert_filter"] = {
+            "n_alert_records": alert_records,
+            "trace_ids": sorted(wanted),
+        }
     if json_output:
         print(json.dumps(report, default=str))
     else:

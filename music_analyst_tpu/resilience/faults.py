@@ -76,6 +76,10 @@ SITES = frozenset(
         # Request-trace flush (telemetry/reqtrace.py): a failing flush must
         # degrade to dropped spans, never block the reply path.
         "reqtrace.flush",
+        # Metrics scrape (observability/metrics_plane.py): a failing
+        # scrape marks the series stale and counts scrape_errors —
+        # serving bytes and replies are never affected.
+        "metrics.scrape",
     }
 )
 

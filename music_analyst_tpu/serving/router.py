@@ -70,6 +70,10 @@ from music_analyst_tpu.serving.batcher import (
     resolve_tp,
     resolve_ttft_slo_ms,
 )
+from music_analyst_tpu.observability.metrics_plane import (
+    configure_metrics,
+    get_metrics_plane,
+)
 from music_analyst_tpu.serving.slo import FairQueue, RateMeter, TokenBucket
 from music_analyst_tpu.telemetry import get_telemetry
 from music_analyst_tpu.telemetry.reqtrace import (
@@ -246,6 +250,12 @@ class ReplicaHandle:
                 original_id, req = entry
                 if req is None:  # stats poll reply
                     self.last_stats = payload.get("stats")
+                    # The poll doubles as the fleet metrics scrape: the
+                    # plane keeps a per-replica series and merges the
+                    # fresh ones (observability/metrics_plane.py).
+                    plane = get_metrics_plane()
+                    if plane.enabled:
+                        plane.ingest_replica(self.name, self.last_stats)
                     continue
                 payload["id"] = original_id
                 rt = get_reqtrace()
@@ -724,6 +734,11 @@ class ReplicaRouter:
             return
         new = "unhealthy" if handle.alive() else "dead"
         self._record_transition(handle, new, kind, reason)
+        # A lost replica cannot be scraped: freeze its series as stale
+        # so the fleet merge stops counting its last numbers as live.
+        plane = get_metrics_plane()
+        if plane.enabled:
+            plane.mark_replica_stale(handle.name)
         handle.close()
         pending = handle.take_pending()
         if not pending:
@@ -954,6 +969,7 @@ def _replica_cmd(
     priority: Optional[int] = None,
     journal_dir: Optional[str] = None,
     trace_sample: Optional[float] = None,
+    metrics_interval_ms: Optional[float] = None,
 ) -> List[str]:
     cmd = [
         sys.executable, "-m", "music_analyst_tpu", "serve",
@@ -984,6 +1000,10 @@ def _replica_cmd(
         # configure_reqtrace; the explicit sample keeps the fleet's
         # head-sampling decision identical even if the env is scrubbed.
         ("--trace-sample", trace_sample),
+        # Same belt-and-braces for the metrics plane: workers inherit
+        # $MUSICAAL_METRICS_* from configure_metrics, the explicit flag
+        # survives a scrubbed environment.
+        ("--metrics-interval-ms", metrics_interval_ms),
     ):
         if value is not None:
             cmd += [flag, str(value)]
@@ -1017,6 +1037,7 @@ def spawn_replicas(
     priority: Optional[int] = None,
     journal_dir: Optional[str] = None,
     trace_sample: Optional[float] = None,
+    metrics_interval_ms: Optional[float] = None,
 ) -> List[ReplicaHandle]:
     """Start ``n`` worker server processes and (optionally) connect.
 
@@ -1049,6 +1070,7 @@ def spawn_replicas(
                 tenant_budget=tenant_budget, priority=priority,
                 journal_dir=replica_journal,
                 trace_sample=trace_sample,
+                metrics_interval_ms=metrics_interval_ms,
             )
             proc = subprocess.Popen(
                 cmd,
@@ -1097,6 +1119,7 @@ def run_router(
     journal_dir: Optional[str] = None,
     trace_sample: Optional[Any] = None,
     trace_dir: Optional[str] = None,
+    metrics_interval_ms: Optional[Any] = None,
 ) -> int:
     """``serve --replicas N`` (N > 1): spawn the fleet, route until
     drained.  The front end is a stock ``SentimentServer`` with the
@@ -1122,6 +1145,12 @@ def run_router(
     reqtrace = configure_reqtrace(
         trace_sample, directory=trace_dir, role="router"
     )
+    # Same ordering for the metrics plane: configure_metrics exports the
+    # resolved interval/dir, so every worker samples its own series into
+    # the shared metrics.jsonl while the router merges their stats polls.
+    metrics = configure_metrics(
+        metrics_interval_ms, directory=trace_dir, role="router"
+    )
     with tel.run_scope("serve", None):
         with tempfile.TemporaryDirectory(prefix="musicaal-fleet-") as base:
             handles = spawn_replicas(
@@ -1137,6 +1166,9 @@ def run_router(
                 trace_sample=(
                     reqtrace.sample if reqtrace.enabled else None
                 ),
+                metrics_interval_ms=(
+                    metrics.interval_ms if metrics.enabled else None
+                ),
             )
             router = ReplicaRouter(
                 handles, max_queue=max_queue, ttft_slo_ms=ttft_slo_ms,
@@ -1146,6 +1178,11 @@ def run_router(
                 router, mode="stdio" if stdio else "unix",
                 decode=_RouterDecode(router), router=router,
             )
+            if metrics.enabled:
+                metrics.attach(
+                    lambda: server.stats_snapshot(include_metrics=False)
+                )
+                metrics.start()
             tel.annotate(
                 serve_mode=server.mode, router_replicas=n, router_tp=tp_width,
             )
@@ -1188,6 +1225,7 @@ def run_router(
                         signal.signal(signum, prev)
                     except (ValueError, OSError):
                         pass
+                metrics.close()
                 reqtrace.close()
                 stats = router.stats()
                 tel.gauge("router.requests_total", stats["admitted"])

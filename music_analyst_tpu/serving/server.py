@@ -66,6 +66,10 @@ from music_analyst_tpu.serving.journal import (
 )
 from music_analyst_tpu.serving.residency import ModelResidency
 from music_analyst_tpu.telemetry import get_telemetry
+from music_analyst_tpu.observability.metrics_plane import (
+    configure_metrics,
+    get_metrics_plane,
+)
 from music_analyst_tpu.telemetry.reqtrace import (
     configure_reqtrace,
     get_reqtrace,
@@ -499,7 +503,7 @@ class SentimentServer:
 
     # ------------------------------------------------------------ readouts
 
-    def stats_snapshot(self) -> Dict[str, Any]:
+    def stats_snapshot(self, include_metrics: bool = True) -> Dict[str, Any]:
         out: Dict[str, Any] = {
             "protocol": PROTOCOL,
             "mode": self.mode,
@@ -533,6 +537,13 @@ class SentimentServer:
                     slo["decode"] = decode_slo
         if slo:
             out["slo"] = slo
+        # Metrics plane (observability/metrics_plane.py) — only when
+        # sampling is on.  The plane's own sampler scrapes with
+        # ``include_metrics=False`` so the series never nests itself.
+        if include_metrics:
+            plane = get_metrics_plane()
+            if plane.enabled:
+                out["metrics"] = plane.snapshot()
         return out
 
 
@@ -652,6 +663,7 @@ def run_server(
     journal_dir: Optional[str] = None,
     trace_sample: Optional[Any] = None,
     trace_dir: Optional[str] = None,
+    metrics_interval_ms: Optional[Any] = None,
 ) -> int:
     """The ``serve`` subcommand: load, warm, then serve until drained.
 
@@ -664,6 +676,13 @@ def run_server(
     # workers the router spawned).  Disabled = inert.
     reqtrace = configure_reqtrace(
         trace_sample, directory=trace_dir, role="server"
+    )
+    # Metrics plane (observability/metrics_plane.py): enabled iff an
+    # interval resolves (--metrics-interval-ms here,
+    # $MUSICAAL_METRICS_INTERVAL_MS in spawned replicas).  Disabled =
+    # zero wire effect.
+    metrics = configure_metrics(
+        metrics_interval_ms, directory=trace_dir, role="server"
     )
     resolved_batch = resolve_max_batch(max_batch)
     with tel.run_scope("serve", None):
@@ -758,6 +777,11 @@ def run_server(
             batcher, residency, mode="stdio" if stdio else "unix",
             decode=decode, journal=journal,
         )
+        if metrics.enabled:
+            metrics.attach(
+                lambda: server.stats_snapshot(include_metrics=False)
+            )
+            metrics.start()
         # Replay BEFORE live traffic: every journaled-but-unanswered
         # request settles (and its reply journals) so reconnecting
         # clients dedup instead of recomputing.
@@ -835,7 +859,9 @@ def run_server(
             # the next start detects it.
             if journal is not None:
                 journal.close()
-            # Kept traces become the Chrome artifact exactly once.
+            # Final metrics sample (baseline + final bracket even the
+            # shortest run), then the Chrome artifact, exactly once.
+            metrics.close()
             reqtrace.close()
             stats = server.stats_snapshot()
             tel.gauge("serving.requests_total",

@@ -263,6 +263,19 @@ def write_run_manifest(
     except Exception:
         pass
     try:
+        # Metrics plane digest (observability/metrics_plane.py): series
+        # counters, active burn-rate alerts, fleet merge — only when
+        # sampling was on, so unmetered runs keep the key set.
+        from music_analyst_tpu.observability.metrics_plane import (
+            get_metrics_plane,
+        )
+
+        plane = get_metrics_plane()
+        if plane.enabled:
+            manifest["metrics"] = plane.snapshot()
+    except Exception:
+        pass
+    try:
         # Watchdog verdicts + flight-record pointer — only when there is
         # something to say, so unwatched runs keep the original key set.
         from music_analyst_tpu.observability.flight import get_flight_recorder

@@ -393,6 +393,7 @@ class RequestTraceRecorder:
                 "wire_s": record.get("wire_s"),
                 "kept": record["kept"],
                 "op": req.op,
+                "t": round(time.time(), 6),
             })
             if len(self._finished) > _MAX_FINISHED:
                 del self._finished[: len(self._finished) - _MAX_FINISHED]
@@ -513,6 +514,25 @@ class RequestTraceRecorder:
                 "p99": pick(0.99),
             }
         }
+
+    def nearest_kept(self, t_wall: Optional[float] = None
+                     ) -> Optional[Dict[str, Any]]:
+        """The tail-kept flushed trace nearest wall-clock ``t_wall`` —
+        what a burn-rate alert embeds so the breach dereferences to a
+        request waterfall.  Falls back to head-sampled traces when
+        nothing was tail-kept, and to the newest flush when no
+        timestamp is given."""
+        with self._lock:
+            finished = list(self._finished)
+        if not finished:
+            return None
+        kept = [f for f in finished if f.get("kept") not in (None, "head")]
+        pool = kept or finished
+        if t_wall is None:
+            return pool[-1]
+        return min(
+            pool, key=lambda f: abs((f.get("t") or 0.0) - float(t_wall))
+        )
 
 
 _DISABLED = RequestTraceRecorder()

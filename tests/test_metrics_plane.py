@@ -1,0 +1,484 @@
+"""Fleet metrics plane (ISSUE 17).
+
+Contract families:
+
+* **resolve** — flag > env > default (off); malformed explicit flag is
+  a usage error, malformed env falls back, like every serving knob.
+* **flatten** — stats snapshots become dotted scalar series; histogram
+  dicts are captured whole AND summarized; junk never lands.
+* **exact fleet merge** — merged histograms match a single-process
+  oracle fed every value (bucket counts, count, sum, min/max exact);
+  rates and counters sum, EWMAs/quantiles never do; stale replicas are
+  listed but excluded.
+* **ring bounds** — the series ring evicts oldest-first at its cap and
+  counts every eviction.
+* **burn-rate alerting** — fires only when BOTH windows burn >= 14x
+  budget, resolves with hysteresis, and each record resolves to the
+  kept trace exemplar nearest the breach.
+* **degradation** — a failed scrape (including fault site
+  ``metrics.scrape``) marks the series stale and counts
+  ``scrape_errors``; nothing is written, nothing raises.
+* **reports** — ``telemetry-report`` reads the trajectory + alert
+  history; ``trace-report`` accepts an alert file and filters the
+  waterfalls to the alert's trace ids.
+"""
+
+import json
+import os
+
+import pytest
+
+from music_analyst_tpu.observability.metrics_plane import (
+    BURN_FIRE,
+    METRICS_FILE,
+    MetricsPlane,
+    configure_metrics,
+    flatten_stats,
+    get_metrics_plane,
+    merge_flat,
+    merge_histograms,
+    resolve_metrics_interval_ms,
+)
+from music_analyst_tpu.telemetry.core import Histogram
+
+
+# ---------------------------------------------------------------- resolve
+
+
+def test_resolve_interval(monkeypatch):
+    monkeypatch.delenv("MUSICAAL_METRICS_INTERVAL_MS", raising=False)
+    assert resolve_metrics_interval_ms(None) == 0.0  # default: off
+    assert resolve_metrics_interval_ms(250) == 250.0
+    assert resolve_metrics_interval_ms("50.5") == 50.5
+    monkeypatch.setenv("MUSICAAL_METRICS_INTERVAL_MS", "100")
+    assert resolve_metrics_interval_ms(None) == 100.0
+    monkeypatch.setenv("MUSICAAL_METRICS_INTERVAL_MS", "junk")
+    assert resolve_metrics_interval_ms(None) == 0.0  # env falls back
+    monkeypatch.setenv("MUSICAAL_METRICS_INTERVAL_MS", "-5")
+    assert resolve_metrics_interval_ms(None) == 0.0
+    with pytest.raises(ValueError):
+        resolve_metrics_interval_ms("junk")  # explicit flag is usage error
+    with pytest.raises(ValueError):
+        resolve_metrics_interval_ms(-1.0)
+
+
+def test_disabled_plane_is_inert(tmp_path):
+    plane = MetricsPlane(0.0, directory=str(tmp_path))
+    assert not plane.enabled
+    plane.attach(lambda: {"requests": {"admitted": 1}})
+    plane.start()  # no thread, no baseline
+    plane.close()
+    assert plane.series() == []
+    assert not (tmp_path / METRICS_FILE).exists()
+    assert get_metrics_plane().enabled is False  # module default: off
+
+
+# ---------------------------------------------------------------- flatten
+
+
+def test_flatten_stats_shapes():
+    hist = Histogram()
+    for v in (0.01, 0.2, 3.0):
+        hist.observe(v)
+    snap = {
+        "requests": {
+            "admitted": 7,
+            "occupancy": 0.5,
+            "draining": False,
+            "latency": hist.as_dict(),
+            "mode": "unix",          # string: dropped
+            "ids": [1, 2, 3],        # list: dropped
+            "missing": None,         # None: dropped
+            "bad": float("nan"),     # non-finite: dropped
+        },
+    }
+    flat, hists = flatten_stats(snap)
+    assert flat["requests.admitted"] == 7.0
+    assert flat["requests.draining"] == 0.0
+    assert flat["requests.latency.count"] == 3.0  # summary fields flatten
+    assert "requests.mode" not in flat
+    assert "requests.ids" not in flat
+    assert "requests.missing" not in flat
+    assert "requests.bad" not in flat
+    assert list(hists) == ["requests.latency"]  # captured whole too
+
+
+# ------------------------------------------------------------ fleet merge
+
+
+def test_histogram_merge_matches_single_process_oracle():
+    import random
+
+    rng = random.Random(7)
+    values = [rng.expovariate(5.0) for _ in range(300)]
+    oracle = Histogram()
+    parts = [Histogram() for _ in range(3)]
+    for i, v in enumerate(values):
+        oracle.observe(v)
+        parts[i % 3].observe(v)
+    merged = merge_histograms([p.as_dict() for p in parts])
+    want = oracle.as_dict()
+    assert merged["buckets_le"] == want["buckets_le"]
+    assert merged["counts"] == want["counts"]  # exact, bucket by bucket
+    assert merged["count"] == want["count"]
+    assert merged["sum_s"] == pytest.approx(want["sum_s"], abs=1e-6)
+    assert merged["min_s"] == pytest.approx(want["min_s"], abs=1e-9)
+    assert merged["max_s"] == pytest.approx(want["max_s"], abs=1e-9)
+    # Quantiles are bucket-derived upper bounds: never below the exact
+    # reservoir answer's bucket, always a real bucket bound (or the max).
+    assert merged["p50_s"] is not None
+
+
+def test_histogram_merge_refuses_mismatched_buckets():
+    a = Histogram(buckets=(0.1, 1.0)).as_dict()
+    b = Histogram(buckets=(0.2, 2.0)).as_dict()
+    assert merge_histograms([a, b]) is None
+    assert merge_histograms([]) is None
+
+
+def test_merge_flat_sums_rates_and_counters_only():
+    replicas = [
+        {"requests.rates.req_s": 10.0, "requests.rates.window_s": 10.0,
+         "requests.admitted": 5.0, "requests.latency.p50_s": 0.2,
+         "requests.occupancy": 0.5},
+        {"requests.rates.req_s": 4.0, "requests.rates.window_s": 10.0,
+         "requests.admitted": 3.0, "requests.latency.p50_s": 0.9,
+         "requests.occupancy": 0.7},
+    ]
+    fleet = merge_flat(replicas)
+    assert fleet["requests.rates.req_s"] == 14.0  # rates sum
+    assert fleet["requests.admitted"] == 8.0      # counters sum
+    assert "requests.latency.p50_s" not in fleet  # quantiles never sum
+    assert "requests.occupancy" not in fleet      # ratios never sum
+    assert "requests.rates.window_s" not in fleet  # config never sums
+
+
+def test_stale_replica_excluded_from_fleet_merge():
+    plane = MetricsPlane(50.0)
+    plane.ingest_replica("r0", {"requests": {"admitted": 10}})
+    plane.ingest_replica("r1", {"requests": {"admitted": 4}})
+    plane.mark_replica_stale("r1")
+    fleet = plane.fleet_snapshot()
+    assert fleet["replica_count"] == 2
+    assert fleet["fresh_count"] == 1
+    assert fleet["stale"] == ["r1"]
+    assert fleet["merged"]["requests.admitted"] == 10.0  # r1 excluded
+    assert fleet["replicas"]["r1"]["stale"] is True
+
+
+def test_ingest_replica_junk_counts_scrape_error():
+    plane = MetricsPlane(50.0)
+    plane.ingest_replica("r0", "not a dict")
+    snap = plane.snapshot()
+    assert snap["scrape_errors"] == 1
+    assert plane.fleet_snapshot()["stale"] == ["r0"]
+
+
+# ------------------------------------------------------------ ring bounds
+
+
+def test_ring_eviction_bounds():
+    plane = MetricsPlane(50.0, max_samples=4)
+    plane.attach(lambda: {"requests": {"admitted": 1}})
+    for _ in range(7):
+        assert plane.sample_now() is not None
+    snap = plane.snapshot()
+    assert snap["samples"] == 7
+    assert snap["series_len"] == 4   # ring capped
+    assert snap["evicted"] == 3      # every eviction counted
+    assert len(plane.series()) == 4
+
+
+# ------------------------------------------------------------ degradation
+
+
+def test_failed_scrape_degrades_to_stale(tmp_path):
+    calls = {"n": 0}
+
+    def source():
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("scrape exploded")
+        return {"requests": {"admitted": calls["n"]}}
+
+    plane = MetricsPlane(50.0, directory=str(tmp_path))
+    plane.attach(source)
+    assert plane.sample_now() is not None
+    assert plane.sample_now() is None       # the failure
+    assert plane.stale is True
+    assert plane.sample_now() is not None   # recovers
+    assert plane.stale is False
+    snap = plane.snapshot()
+    assert snap["samples"] == 2
+    assert snap["scrape_errors"] == 1
+    # The failed scrape wrote nothing: every line intact, sample-typed.
+    lines = (tmp_path / METRICS_FILE).read_text().splitlines()
+    assert len(lines) == 2
+    assert all(json.loads(l)["type"] == "sample" for l in lines)
+
+
+def test_fault_site_metrics_scrape(tmp_path):
+    from music_analyst_tpu.resilience import configure_faults, fault_stats
+
+    plane = MetricsPlane(50.0, directory=str(tmp_path))
+    plane.attach(lambda: {"requests": {"admitted": 1}})
+    configure_faults("metrics.scrape:error@1+")
+    try:
+        assert plane.sample_now() is None
+        assert plane.sample_now() is None
+        trips = fault_stats()["metrics.scrape"]["trips"]
+    finally:
+        configure_faults(None)
+    assert trips == 2
+    assert plane.snapshot()["scrape_errors"] == trips
+    assert not (tmp_path / METRICS_FILE).exists()  # nothing ever landed
+
+
+def test_prom_exposition_written(tmp_path):
+    hist = Histogram()
+    hist.observe(0.05)
+    plane = MetricsPlane(50.0, directory=str(tmp_path))
+    plane.attach(lambda: {
+        "requests": {"admitted": 3, "latency": hist.as_dict()},
+    })
+    plane.sample_now()
+    text = (tmp_path / f"metrics.{os.getpid()}.prom").read_text()
+    assert "musicaal_requests_admitted 3" in text
+    assert 'musicaal_requests_latency_bucket{le="+Inf"} 1' in text
+    assert "musicaal_requests_latency_count 1" in text
+
+
+# -------------------------------------------------------------- burn rate
+
+
+def _burn_sample(t, shed, admitted, ttft_misses=0, total=0):
+    metrics = {
+        "slo.tenants.bulk.shed": float(shed),
+        "slo.tenants.bulk.admitted": float(admitted),
+    }
+    if total:
+        metrics["requests.admitted"] = float(total)
+        metrics["decode.ttft_slo_misses"] = float(ttft_misses)
+    return {"type": "sample", "t": float(t), "pid": 0, "role": "test",
+            "metrics": metrics}
+
+
+def test_burn_alert_fires_and_resolves_with_hysteresis():
+    plane = MetricsPlane(50.0)
+    # Baseline, then a burst: 90 sheds of 100 offered = 90x budget on
+    # both windows (fast and slow windows both reach back to baseline).
+    plane._series.append(_burn_sample(1000.0, 0, 0))
+    burst = _burn_sample(1030.0, 90, 10)
+    plane._series.append(burst)
+    records = plane._evaluate_alerts(burst)
+    assert [r["state"] for r in records] == ["firing"]
+    assert records[0]["alert"] == "shed_burn_rate"
+    assert records[0]["tenant"] == "bulk"
+    assert records[0]["burn_fast"] >= BURN_FIRE
+    assert records[0]["burn_slow"] >= BURN_FIRE
+    # Still burning a minute later: active alert does not re-fire.
+    still = _burn_sample(1059.0, 95, 12)
+    plane._series.append(still)
+    assert plane._evaluate_alerts(still) == []
+    assert len(plane.alerts(active_only=True)) == 1
+    # Recovery: inside the fast window the shed counter goes flat while
+    # admits keep flowing — fast burn drops under the resolve threshold.
+    plane._series.append(_burn_sample(1150.0, 95, 200))
+    calm = _burn_sample(1200.0, 95, 260)
+    plane._series.append(calm)
+    records = plane._evaluate_alerts(calm)
+    assert [r["state"] for r in records] == ["resolved"]
+    assert plane.alerts(active_only=True) == []
+    snap = plane.snapshot()
+    assert snap["alerts_fired"] == 1
+    assert snap["alerts_resolved"] == 1
+
+
+def test_burn_alert_needs_both_windows():
+    plane = MetricsPlane(50.0)
+    # Long healthy history, then a fast-window-only spike: the slow
+    # window (10 min of near-zero burn) must hold the pager.
+    plane._series.append(_burn_sample(1000.0, 0, 10_000))
+    plane._series.append(_burn_sample(1550.0, 0, 20_000))
+    spike = _burn_sample(1595.0, 30, 20_100)
+    plane._series.append(spike)
+    assert plane._evaluate_alerts(spike) == []
+
+
+def test_steady_state_stays_silent():
+    plane = MetricsPlane(50.0)
+    for i in range(5):
+        s = _burn_sample(1000.0 + i, 0, 100 * (i + 1))
+        plane._series.append(s)
+        assert plane._evaluate_alerts(s) == []
+    assert plane.alerts() == []
+
+
+def test_alert_record_carries_nearest_kept_trace(tmp_path):
+    from music_analyst_tpu.telemetry.reqtrace import configure_reqtrace
+
+    rt = configure_reqtrace(0.0, directory=str(tmp_path))
+    try:
+        class _Req:
+            def __init__(self):
+                self.id = "r1"
+                self.op = "echo"
+                self.tenant = "bulk"
+                self.priority = 1
+                self.meta = {}
+                self.response = {"ok": False,
+                                 "error": {"kind": "queue_full"}}
+
+        req = _Req()
+        rt.begin_request(req)
+        rt.on_complete(req, req.response)  # shed settle: tail-keeps
+        rt.finish_request(req)
+        kept = rt.nearest_kept()
+        assert kept is not None and kept["kept"] not in (None, "head")
+
+        plane = MetricsPlane(50.0)
+        plane._series.append(_burn_sample(1000.0, 0, 0))
+        burst = _burn_sample(1030.0, 90, 10)
+        plane._series.append(burst)
+        records = plane._evaluate_alerts(burst)
+        assert records and records[0]["trace_id"] == kept["trace_id"]
+    finally:
+        os.environ.pop("MUSICAAL_TRACE_DIR", None)
+        os.environ.pop("MUSICAAL_TRACE_SAMPLE", None)
+        configure_reqtrace(None, None)
+
+
+def test_nearest_kept_picks_closest_in_time(tmp_path):
+    from music_analyst_tpu.telemetry.reqtrace import configure_reqtrace
+
+    rt = configure_reqtrace(0.0, directory=str(tmp_path))
+    try:
+        with rt._lock:
+            rt._finished.extend([
+                {"trace_id": "aaa", "kept": "shed", "t": 100.0},
+                {"trace_id": "bbb", "kept": "slow", "t": 200.0},
+                {"trace_id": "ccc", "kept": None, "t": 150.0},
+            ])
+        assert rt.nearest_kept(105.0)["trace_id"] == "aaa"
+        assert rt.nearest_kept(190.0)["trace_id"] == "bbb"
+        assert rt.nearest_kept()["trace_id"] == "bbb"  # newest kept
+    finally:
+        os.environ.pop("MUSICAAL_TRACE_DIR", None)
+        os.environ.pop("MUSICAAL_TRACE_SAMPLE", None)
+        configure_reqtrace(None, None)
+
+
+# ----------------------------------------------------- sampling lifecycle
+
+
+def test_start_close_bounds_series(tmp_path):
+    plane = MetricsPlane(10_000.0, directory=str(tmp_path))
+    plane.attach(lambda: {"requests": {"admitted": 1}})
+    plane.start()   # baseline sample, interval far beyond the test
+    plane.close()   # final sample
+    assert plane.snapshot()["samples"] == 2  # baseline + final, always
+    lines = (tmp_path / METRICS_FILE).read_text().splitlines()
+    assert len(lines) == 2
+    plane.close()  # idempotent
+    assert plane.snapshot()["samples"] == 2
+
+
+def test_configure_metrics_exports_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("MUSICAAL_METRICS_INTERVAL_MS", raising=False)
+    monkeypatch.delenv("MUSICAAL_METRICS_DIR", raising=False)
+    plane = configure_metrics(125.0, directory=str(tmp_path))
+    try:
+        assert plane.enabled and get_metrics_plane() is plane
+        assert float(os.environ["MUSICAAL_METRICS_INTERVAL_MS"]) == 125.0
+        assert os.environ["MUSICAAL_METRICS_DIR"] == str(tmp_path)
+    finally:
+        monkeypatch.delenv("MUSICAAL_METRICS_INTERVAL_MS", raising=False)
+        monkeypatch.delenv("MUSICAAL_METRICS_DIR", raising=False)
+        assert not configure_metrics(None, None).enabled
+
+
+# ---------------------------------------------------------------- reports
+
+
+def _write_metrics_jsonl(path, trace_id="t-123"):
+    lines = [
+        {"type": "sample", "t": 10.0, "pid": 1, "role": "server",
+         "metrics": {"requests.rates.req_s": 5.0,
+                     "requests.admitted": 10.0}},
+        {"type": "sample", "t": 20.0, "pid": 1, "role": "server",
+         "metrics": {"requests.rates.req_s": 9.0,
+                     "requests.admitted": 80.0}},
+        {"type": "alert", "schema": 1, "alert": "shed_burn_rate",
+         "state": "firing", "severity": "page", "t": 20.0, "pid": 1,
+         "role": "server", "tenant": "bulk", "burn_fast": 90.0,
+         "burn_slow": 88.0, "threshold": 14.0, "budget": 0.01,
+         "window_fast_s": 60.0, "window_slow_s": 600.0,
+         "trace_id": trace_id, "trace_kept": "shed"},
+    ]
+    with open(path, "w", encoding="utf-8") as fh:
+        for line in lines:
+            fh.write(json.dumps(line) + "\n")
+
+
+def test_telemetry_report_reads_metrics_trajectory(tmp_path, capsys):
+    from music_analyst_tpu.observability.report import run_telemetry_report
+
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    (run_dir / "run_manifest.json").write_text(json.dumps({
+        "schema": 1, "engine": "serve", "wall_seconds": 1.0,
+        "counters": {}, "histograms": {},
+    }))
+    _write_metrics_jsonl(run_dir / "metrics.jsonl")
+    assert run_telemetry_report([str(run_dir)]) == 0
+    out = capsys.readouterr().out
+    assert "metrics plane" in out
+    assert "requests.rates.req_s: 5.00 -> 9.00" in out
+    assert "burn-rate alert history:" in out
+    assert "shed_burn_rate tenant=bulk: firing" in out
+    assert "trace=t-123" in out
+
+
+def test_trace_report_accepts_alert_file(tmp_path, capsys):
+    from music_analyst_tpu.observability.report import run_trace_report
+
+    def _trace(trace_id):
+        return {
+            "schema": 1, "trace_id": trace_id, "span": "s-" + trace_id,
+            "role": "server", "pid": 1, "op": "echo", "kept": "shed",
+            "wire_s": 0.01,
+            "spans": [
+                {"name": "admit", "cat": "phase", "t": 0.0, "dur": 0.004},
+                {"name": "reply", "cat": "phase", "t": 0.004, "dur": 0.006},
+            ],
+        }
+
+    with open(tmp_path / "request_traces.jsonl", "w") as fh:
+        fh.write(json.dumps(_trace("t-123")) + "\n")
+        fh.write(json.dumps(_trace("t-999")) + "\n")
+    _write_metrics_jsonl(tmp_path / "metrics.jsonl", trace_id="t-123")
+    # The whole dir: both traces.
+    assert run_trace_report([str(tmp_path)]) == 0
+    assert "2 trace(s)" in capsys.readouterr().out
+    # The alert file: filtered to the breaching trace only.
+    assert run_trace_report([str(tmp_path / "metrics.jsonl")]) == 0
+    out = capsys.readouterr().out
+    assert "1 trace(s)" in out
+    assert "alert filter: 1 alert record(s) -> 1 trace id(s)" in out
+    assert "t-123" in out and "t-999" not in out
+
+
+def test_offered_load_series():
+    from benchmarks.loadgen import Arrival, offered_load_series
+
+    arrivals = [
+        Arrival(t_s=0.1, tenant="bulk", priority=1),
+        Arrival(t_s=0.9, tenant="gold", priority=5),
+        Arrival(t_s=1.2, tenant="bulk", priority=1),
+    ]
+    series = offered_load_series(arrivals)
+    assert series == [
+        {"t_s": 0, "req_s": 2,
+         "classes": {"bulk/p1": 1, "gold/p5": 1}},
+        {"t_s": 1, "req_s": 1, "classes": {"bulk/p1": 1}},
+    ]

@@ -113,6 +113,47 @@ print("burn-rate self-check ok: shed_burn_rate tenant=bulk,",
 endef
 export BURN_SELFCHECK
 
+# Paged-attention kernel self-check body (exported below; run with
+# $(PY) -c "$$KERNEL_SELFCHECK"): random pool/table/mask with odd valid
+# lengths and a trash-page table row, both Pallas bodies (exact batched
+# and the page-streaming TPU body) run in interpret mode against the
+# naive f32 gather oracle, then the int8 path with dequant fused into
+# the KV-load epilogue.
+define KERNEL_SELFCHECK
+import numpy as np
+import jax.numpy as jnp
+from music_analyst_tpu.ops.paged_attention import (
+    paged_attention, paged_attention_reference)
+from music_analyst_tpu.ops.quant import quantize_kv_page
+rng = np.random.RandomState(0)
+P, pps, n, n_kv, H, D = 8, 4, 3, 2, 4, 8
+n_pages = n * pps
+table = rng.permutation(n_pages).reshape(n, pps).astype(np.int32)
+table[0, -1] = n_pages  # trash page
+lengths = np.array([13, 7, 21], np.int32)  # odd, off the page grid
+mask = jnp.asarray(np.arange(pps * P)[None, :] < lengths[:, None])
+shape = (n_pages + 1, P, n_kv, D)
+k = jnp.asarray(rng.standard_normal(shape), dtype=jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal(shape), dtype=jnp.bfloat16)
+q = jnp.asarray(rng.standard_normal((n, 1, H, D)), dtype=jnp.bfloat16)
+t = jnp.asarray(table)
+ref = np.asarray(paged_attention_reference(q, k, v, t, mask))
+for stream in (False, True):
+    out = np.asarray(paged_attention(
+        q, k, v, t, mask, interpret=True, stream=stream), np.float32)
+    assert np.allclose(out, ref, atol=0.06, rtol=0.06), \
+        f"stream={stream} body diverged from the f32 oracle"
+kq, ks = quantize_kv_page(k.astype(jnp.float32))
+vq, vs = quantize_kv_page(v.astype(jnp.float32))
+out8 = np.asarray(paged_attention(
+    q, kq, vq, t, mask, key_scale=ks, value_scale=vs,
+    interpret=True), np.float32)
+assert np.allclose(out8, ref, atol=0.15), "int8 path diverged"
+print("paged-attention kernel self-check ok:",
+      "exact+stream+int8 vs oracle at P=8, odd lengths, trash row")
+endef
+export KERNEL_SELFCHECK
+
 # Fast observability gate: profiling + telemetry + pipeline +
 # observability + corpus-cache/streaming unit tests, then one
 # smoke-shaped bench.py run through the full parent/child/--baseline
@@ -129,9 +170,15 @@ smoke:
 		tests/test_observability.py tests/test_corpus_cache.py \
 		tests/test_wq_store.py tests/test_serving.py \
 		tests/test_resilience.py tests/test_continuous.py \
-		tests/test_kv_pages.py tests/test_router.py \
+		tests/test_kv_pages.py tests/test_paged_attention.py \
+		tests/test_router.py \
 		tests/test_journal.py tests/test_speculative.py \
 		tests/test_reqtrace.py tests/test_metrics_plane.py -q
+	# paged-attention kernel self-check (body in KERNEL_SELFCHECK above):
+	# both interpret-mode kernel bodies + the int8 path vs the f32 oracle.
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+		$(PY) -c "$$KERNEL_SELFCHECK" || \
+		{ echo "paged-attention kernel self-check failed"; exit 1; }
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= MUSICAAL_BENCH_SMOKE=1 \
 		$(PY) bench.py --baseline --attempts 1 --deadline 240 \
 		| $(PY) -c "import json,sys; \

@@ -114,6 +114,11 @@ for suite in $suites; do
         elif [ "$suite" = "continuous" ] && ! grep -q '"prefix_sharing"' "$tmp"; then
             rm -f "$tmp"
             echo "    REFUSED: continuous output lacks prefix_sharing rows" >&2
+        # ... and the paged-attention kernel A/B rows (PERFORMANCE.md
+        # reads the gather/scatter-retirement table from them).
+        elif [ "$suite" = "continuous" ] && ! grep -q '"paged_kernel"' "$tmp"; then
+            rm -f "$tmp"
+            echo "    REFUSED: continuous output lacks paged_kernel rows" >&2
         else
             mv "$tmp" "$out_dir/$suite.json"
             echo "    captured -> $out_dir/$suite.json" >&2

@@ -674,6 +674,64 @@ def _preempt_scenario() -> dict:
     }
 
 
+def _kv_quant_scenario(n_requests: int) -> dict:
+    """Injected ``kv_quant.dequant`` fault: the quantized read path is
+    unavailable, so an int8 scheduler degrades to the unquantized paged
+    pool at construction — before any page is written.  Replies must be
+    byte-identical to a clean ``kv_quant="none"`` run, and the degrade
+    must be visible in the serving stats' ``kv_quant`` block."""
+    from music_analyst_tpu.models.llama import (
+        LlamaConfig,
+        LlamaZeroShotClassifier,
+    )
+    from music_analyst_tpu.resilience import configure_faults, fault_stats
+    from music_analyst_tpu.serving.decode_loop import ContinuousScheduler
+
+    clf = LlamaZeroShotClassifier(
+        config=LlamaConfig.tiny(), max_prompt_len=64
+    )
+    prompts = [f"quantized chaos lyric {i}" for i in range(n_requests)]
+    kw = dict(n_slots=2, prefill_chunk=16, prompt_region=64,
+              max_new_tokens=4, max_queue=n_requests + 1)
+
+    def _texts(sched):
+        reqs = [
+            sched.submit(i, p, max_new_tokens=4)
+            for i, p in enumerate(prompts)
+        ]
+        sched.run_until_idle()
+        out = []
+        for req in reqs:
+            resp = req.response or {}
+            if not resp.get("ok"):
+                raise RuntimeError(f"generate {req.id} failed: "
+                                   f"{resp.get('error')}")
+            out.append(resp["text"])
+        return out
+
+    clean = _texts(ContinuousScheduler(clf, kv_quant="none", **kw))
+    start = time.perf_counter()
+    configure_faults("kv_quant.dequant:error@1+")
+    try:
+        sched = ContinuousScheduler(clf, kv_quant="int8", **kw)
+        trips = fault_stats()["kv_quant.dequant"]["trips"]
+    finally:
+        configure_faults(None)
+    faulted = _texts(sched)
+    elapsed = time.perf_counter() - start
+    stats = sched.stats()["kv_quant"]
+    return {
+        "scenario": "kv_quant_dequant_fault",
+        "spec": "kv_quant.dequant:error@1+",
+        "requests": n_requests,
+        "bytes_identical": faulted == clean,
+        "degraded": stats["degraded"],
+        "scheme_after": stats["scheme"],
+        "trips": trips,
+        "wall_s": round(elapsed, 4),
+    }
+
+
 @suite("chaos")
 def run() -> dict:
     from music_analyst_tpu.resilience import (
@@ -790,6 +848,15 @@ def run() -> dict:
             file=sys.stderr,
         )
 
+        kv_quant = _kv_quant_scenario(4 if smoke() else 16)
+        print(
+            f"[chaos] kv_quant: identical="
+            f"{kv_quant['bytes_identical']} "
+            f"degraded={kv_quant['degraded']} "
+            f"wall={kv_quant['wall_s']:.3f}s",
+            file=sys.stderr,
+        )
+
         journal_wal = _journal_scenario()
         print(
             f"[chaos] journal_append: degraded_to_recompute="
@@ -831,6 +898,7 @@ def run() -> dict:
         "prefix_lookup": prefix,
         "spec_draft": spec_draft,
         "preempt_fault": preempt,
+        "kv_quant_fault": kv_quant,
         "journal_append": journal_wal,
         "reqtrace_flush": reqtrace_flush,
         "metrics_scrape": metrics_scrape,
@@ -838,6 +906,7 @@ def run() -> dict:
             s["bytes_identical"] for s in scenarios
         ) and prefix["bytes_identical"] and spec_draft["bytes_identical"]
         and preempt["bytes_identical"]
+        and kv_quant["bytes_identical"]
         and reqtrace_flush["bytes_identical"]
         and metrics_scrape["bytes_identical"],
         "all_recovered": all(
@@ -849,6 +918,7 @@ def run() -> dict:
         and spec_draft["all_fell_back"]
         and preempt["preempt_faults"] > 0
         and preempt["preemptions_faulted"] == 0
+        and kv_quant["degraded"]
         and journal_wal["degraded_to_recompute"]
         and reqtrace_flush["degraded_to_drops"]
         and metrics_scrape["degraded_to_stale"],

@@ -380,11 +380,20 @@ class MultiHeadAttention(nn.Module):
             k = apply_rope(k, cos, sin, positions)
 
         new_cache = None
+        paged = False
         if cache is not None:
             new_cache = cache.update(k, v)
-            k, v = new_cache.keys, new_cache.values
+            # A paged cache (ops/paged_attention.PagedAttnView) carries
+            # the physical page pool, not a contiguous buffer: its
+            # ``attend`` runs the fused gather+QK+softmax+V kernel, so
+            # the contiguous k/v unpack below never happens for it.
+            paged = hasattr(new_cache, "attend")
+            if not paged:
+                k, v = new_cache.keys, new_cache.values
 
-        if self.attn_impl == "flash" and cache is None:
+        if paged:
+            out = new_cache.attend(q, mask)
+        elif self.attn_impl == "flash" and cache is None:
             from music_analyst_tpu.ops.flash_attention import flash_attention
 
             # The flash kernel expresses masking ONLY via flash_causal +

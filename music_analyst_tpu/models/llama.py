@@ -954,6 +954,7 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         decode_span: int = 4,
         page_size: int = 16,
         kv_pages: int = 0,
+        kv_quant: str = "none",
     ):
         """Build the prefix-shared paged decode runtime for this model.
 
@@ -966,6 +967,9 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         *token ids* (whatever tokenizer is resolved), not on text, so
         byte/llama tokenizers share exactly what their encodings share.
         ``kv_pages=0`` auto-sizes the pool to one full sequence per slot.
+        ``kv_quant="int8"`` stores the page pool as int8 codes with
+        per-(page, row) scales, dequantized inside the fused
+        paged-attention kernel (ops/paged_attention.py).
         """
         import math
 
@@ -995,7 +999,7 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         )
         eos_id = getattr(self.tokenizer, "eos_id", ByteTokenizer.EOS)
         return PagedDecodeRuntime(self.model, self.config, plan, eos_id,
-                                  mesh=self.mesh)
+                                  mesh=self.mesh, kv_quant=kv_quant)
 
     def generate_batch_continuous(
         self,
@@ -1007,6 +1011,7 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         budgets: Optional[Sequence[int]] = None,
         page_size: Optional[int] = None,
         kv_pages: Optional[int] = None,
+        kv_quant: Optional[str] = None,
         prefix_cache: bool = True,
         speculate_k: Optional[int] = None,
     ) -> List[str]:
@@ -1052,7 +1057,7 @@ class LlamaZeroShotClassifier(ClassifierBackend):
         chunk = min(int(prefill_chunk), region)
         cap = max(1, max(budgets))
         key = (n_slots, chunk, region, cap, int(decode_span),
-               page_size, kv_pages, bool(prefix_cache), speculate_k)
+               page_size, kv_pages, kv_quant, bool(prefix_cache), speculate_k)
         sched = self._slot_schedulers.get(key)
         if sched is None:
             sched = ContinuousScheduler(
@@ -1065,6 +1070,7 @@ class LlamaZeroShotClassifier(ClassifierBackend):
                 max_queue=max(len(prompts), 64),
                 page_size=page_size,
                 kv_pages=kv_pages,
+                kv_quant=kv_quant,
                 prefix_cache=prefix_cache,
                 speculate_k=speculate_k,
             )

@@ -63,11 +63,42 @@ import jax
 import jax.numpy as jnp
 
 from music_analyst_tpu.models.layers import KVCache
+from music_analyst_tpu.ops.paged_attention import PagedAttnView
+from music_analyst_tpu.ops.quant import quantize_kv_page
 from music_analyst_tpu.profiling.compile import profiled_jit
+
+KV_QUANT_SCHEMES = ("none", "int8")
 
 
 def _is_pow2(n: int) -> bool:
     return n >= 1 and not (n & (n - 1))
+
+
+@dataclasses.dataclass
+class QuantizedKVPages:
+    """int8 page pool: codes + per-(page, row) f32 dequant scales.
+
+    The quantized twin of the per-layer ``KVCache`` pool — same
+    ``[n_pages + 1, page_size, n_kv, head_dim]`` geometry with int8
+    codes, plus ``[n_pages + 1, page_size]`` scale planes for K and V
+    (``ops/quant.quantize_kv_page``).  A registered pytree whose leaves
+    ride along wherever the float pool's did, so page copy, free,
+    checkpointing, and pin transfers move scales with their pages for
+    free — the scheduler never special-cases quantization.
+    """
+
+    keys: jax.Array          # int8 [n_pages + 1, P, n_kv, D]
+    values: jax.Array
+    key_scale: jax.Array     # f32 [n_pages + 1, P]
+    value_scale: jax.Array
+    length: jax.Array        # int32 [n_slots] write offsets (bookkeeping)
+
+
+jax.tree_util.register_dataclass(
+    QuantizedKVPages,
+    data_fields=["keys", "values", "key_scale", "value_scale", "length"],
+    meta_fields=[],
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -156,7 +187,7 @@ class PagedDecodeRuntime:
     """
 
     def __init__(self, model, config, plan: PagePlan, eos_id: int,
-                 mesh=None) -> None:
+                 mesh=None, kv_quant: str = "none") -> None:
         self.model = model
         self.config = config
         self.plan = plan
@@ -166,6 +197,16 @@ class PagedDecodeRuntime:
         # replicated traced operand, so gather/scatter indices are shared
         # by every chip and only head-local bytes move.
         self.mesh = mesh
+        if kv_quant not in KV_QUANT_SCHEMES:
+            raise ValueError(
+                f"kv_quant must be one of {KV_QUANT_SCHEMES}, got {kv_quant!r}"
+            )
+        self.kv_quant = kv_quant
+        quantized = kv_quant == "int8"
+        # The dtype KV rows dequantize to (and the unquantized pool's
+        # storage dtype): the model's activation dtype.
+        compute_dtype = jnp.bfloat16
+        self._compute_dtype = compute_dtype
         if plan.max_total > config.max_seq_len:
             raise ValueError(
                 f"prompt_region + max_new ({plan.max_total}) exceeds the "
@@ -180,26 +221,60 @@ class PagedDecodeRuntime:
         eos = jnp.asarray(self.eos_id, jnp.int32)
         # Pages a chunk write can straddle: C tokens starting at a multiple
         # of C touch at most one leading partial page + the full pages.
+        # (Decode and verify no longer scatter — their writes land in the
+        # pool row-by-row through the kernel-backed view.)
         n_wp_prefill = (C - 1) // P + 2
-        n_wp_decode = (plan.decode_span - 1) // P + 2
 
-        def _view(c: KVCache, row, length) -> KVCache:
+        def _view(c, row, length) -> KVCache:
             """Contiguous [B, max_total] view of the rows behind ``row``.
 
             ``row`` is ``[pps]`` (prefill, B=1) or ``[n_slots, pps]``
             (decode).  The view is sliced to exactly ``max_total`` rows so
             every downstream op — masks, softmax widths, reductions — is
-            bit-identical to the monolithic runtime's buffer.
+            bit-identical to the monolithic runtime's buffer.  Prefill is
+            the only remaining consumer (decode and verify read the pool
+            through the fused kernel); for int8 the gathered codes
+            dequantize here, so the chunk-prefill math runs on the same
+            bf16 rows the kernel's load epilogue reconstructs.
             """
             keys = jnp.take(c.keys, row, axis=0)
             values = jnp.take(c.values, row, axis=0)
+            if quantized:
+                ks = jnp.take(c.key_scale, row, axis=0)[..., None, None]
+                vs = jnp.take(c.value_scale, row, axis=0)[..., None, None]
+                keys = (keys.astype(jnp.float32) * ks).astype(compute_dtype)
+                values = (values.astype(jnp.float32) * vs).astype(
+                    compute_dtype
+                )
             if row.ndim == 1:
-                shape = (1, span) + c.keys.shape[2:]
+                shape = (1, span) + keys.shape[-2:]
             else:
-                shape = (row.shape[0], span) + c.keys.shape[2:]
+                shape = (row.shape[0], span) + keys.shape[-2:]
             keys = keys.reshape(shape)[:, :total]
             values = values.reshape(shape)[:, :total]
             return KVCache(keys, values, length)
+
+        def _attn_view(c, page_table, length) -> PagedAttnView:
+            """The kernel-backed cache for decode/verify: binds the pool
+            (+ scales), the table, and per-slot write offsets — no
+            gathered copy."""
+            return PagedAttnView(
+                keys=c.keys, values=c.values,
+                key_scale=c.key_scale if quantized else None,
+                value_scale=c.value_scale if quantized else None,
+                table=page_table, length=length,
+                page_size=P, total=total,
+            )
+
+        def _repack(v: PagedAttnView, length):
+            """Pool state back out of a scanned view (decode/verify write
+            pages in place through the view, so the view IS the new
+            pool)."""
+            if quantized:
+                return QuantizedKVPages(
+                    v.keys, v.values, v.key_scale, v.value_scale, length
+                )
+            return KVCache(v.keys, v.values, length)
 
         def _pages(arr):
             """[B, max_total] view back to per-page layout [B, pps, P, ...],
@@ -242,36 +317,61 @@ class PagedDecodeRuntime:
                 vk = _pages(v.keys)[0]    # [pps, P, n_kv, D]
                 vv = _pages(v.values)[0]
                 keys, values = c.keys, c.values
+                if quantized:
+                    key_scale, value_scale = c.key_scale, c.value_scale
                 for j in range(n_wp_prefill):
                     lp = jnp.clip(lp0 + j, 0, pps - 1)
                     phys = page_row[lp]
                     pk = jax.lax.dynamic_slice_in_dim(vk, lp, 1, axis=0)
                     pv = jax.lax.dynamic_slice_in_dim(vv, lp, 1, axis=0)
+                    if quantized:
+                        # Quantize the page on the way out: per-row
+                        # symmetric int8 + scale.  Rows the chunk only
+                        # re-gathered (below ``start`` on the boundary
+                        # page) round-trip through the bf16 view to
+                        # within ±1 code, then sit at a fixed point of
+                        # further rescatters — see
+                        # ops/quant.quantize_kv_page.
+                        pk, psk = quantize_kv_page(pk)
+                        pv, psv = quantize_kv_page(pv)
+                        key_scale = jax.lax.dynamic_update_slice(
+                            key_scale, psk, (phys, 0)
+                        )
+                        value_scale = jax.lax.dynamic_update_slice(
+                            value_scale, psv, (phys, 0)
+                        )
                     keys = jax.lax.dynamic_update_slice(
                         keys, pk, (phys,) + (0,) * (keys.ndim - 1)
                     )
                     values = jax.lax.dynamic_update_slice(
                         values, pv, (phys,) + (0,) * (values.ndim - 1)
                     )
-                new_caches.append(
-                    KVCache(keys, values, c.length.at[slot].set(length_after))
-                )
+                length = c.length.at[slot].set(length_after)
+                if quantized:
+                    new_caches.append(QuantizedKVPages(
+                        keys, values, key_scale, value_scale, length
+                    ))
+                else:
+                    new_caches.append(KVCache(keys, values, length))
             return new_caches, first
 
         def _decode_step(params, caches, page_table, tokens, prompt_lens,
                          steps, budgets, done, active):
             """``decode_span`` greedy steps over all slots in one dispatch.
 
-            The scan body is byte-for-byte ``slots.decode`` over the
-            gathered views; afterwards only the *decode* pages (slot-local
-            index >= prompt_pages) are scattered back, so a shared prompt
-            page is never written by decode.  Free slots' table rows point
-            at the trash page, and their per-step writes are identical
-            across slots (same zero inputs), so duplicate scatter indices
-            carry duplicate data.
+            The scan body is the same 1-wide step as ``slots.decode``,
+            but the cache it threads is a :class:`PagedAttnView`: each
+            step writes its new KV row straight into its physical page
+            and attends through the fused kernel, so the scan carries
+            the page *pool* itself — no gathered copy in, no page
+            scatter out.  Write offsets sit at ``R + steps < total``, so
+            every write lands in the slot's decode pages and shared
+            prompt pages are never touched; free slots' table rows point
+            at the trash page, which is never read through an active
+            mask (and a free slot's own masked read of it is discarded
+            by the ``adv`` select).
             """
-            steps0 = steps
-            views = [_view(c, page_table, c.length) for c in caches]
+            views = [_attn_view(c, page_table, c.length) for c in caches]
             kv_pos = jnp.arange(total, dtype=jnp.int32)[None, None, None, :]
 
             def body(carry, _):
@@ -279,7 +379,7 @@ class PagedDecodeRuntime:
                 adv = active & (steps < budgets)
                 offsets = jnp.minimum(R + steps, total - 1)
                 views_in = [
-                    KVCache(v.keys, v.values, offsets) for v in views
+                    dataclasses.replace(v, length=offsets) for v in views
                 ]
                 pos = prompt_lens + steps
                 prompt_part = kv_pos < prompt_lens[:, None, None, None]
@@ -303,51 +403,36 @@ class PagedDecodeRuntime:
                 body, (tokens, steps, done, views),
                 None, length=plan.decode_span,
             )
-            # Scatter back the decode pages this dispatch could have
-            # written: slot-local pages [lp0, lp0 + n_wp_decode), clamped
-            # into [prompt_pages, pps) so prompt pages stay untouched.
-            lp0 = (R + steps0) // P
-            n_rows = jnp.arange(plan.n_slots)
-            new_caches = []
-            for c, v in zip(caches, views):
-                vk = _pages(v.keys)       # [n, pps, P, n_kv, D]
-                vv = _pages(v.values)
-                keys, values = c.keys, c.values
-                for j in range(n_wp_decode):
-                    lp = jnp.clip(lp0 + j, plan.prompt_pages, pps - 1)  # [n]
-                    phys = page_table[n_rows, lp]                       # [n]
-                    keys = keys.at[phys].set(vk[n_rows, lp])
-                    values = values.at[phys].set(vv[n_rows, lp])
-                new_caches.append(KVCache(keys, values, c.length))
+            new_caches = [
+                _repack(v, c.length) for c, v in zip(caches, views)
+            ]
             return new_caches, tokens, steps, done, emitted
 
         def _verify_block(params, caches, page_table, tokens_blk, prompt_lens,
                           steps):
             """Score a ``[n_slots, K]`` drafted block in one dispatch.
 
-            Identical semantics to ``slots.verify`` over the gathered
-            views (column 0 = carry, columns 1.. = drafts; returns the
-            greedy argmax after consuming each prefix — see ``kv_slots``):
-            a teacher-forced scan of the *same* 1-wide step body as
-            ``pages.decode``, because byte-identity demands the logits and
-            written KV rows be bit-identical to plain decode (a K-wide
-            scoring pass reduces in a different order and flips argmax
-            near-ties).  The paged gather in and decode-page scatter out
-            match ``pages.decode``: only slot-local pages >=
-            ``prompt_pages`` are written back, so shared prompt pages are
-            never touched by a rejected draft.  Free slots' rows point at
-            the trash page and receive identical (all-zero-input) writes.
+            Identical semantics to ``slots.verify`` (column 0 = carry,
+            columns 1.. = drafts; returns the greedy argmax after
+            consuming each prefix — see ``kv_slots``): a teacher-forced
+            scan of the *same* 1-wide kernel-backed step body as
+            ``pages.decode``, because byte-identity demands the logits
+            and written KV rows be bit-identical to plain decode (a
+            K-wide scoring pass reduces in a different order and flips
+            argmax near-ties).  Rejected drafts' rows stay in the decode
+            pages but are never attended — the masks derive from the
+            host-committed ``steps``, exactly as on the scatter path
+            this replaces; shared prompt pages are never written (write
+            offsets ``>= R``).
             """
-            K = tokens_blk.shape[1]
-            steps0 = steps
-            views = [_view(c, page_table, c.length) for c in caches]
+            views = [_attn_view(c, page_table, c.length) for c in caches]
             kv_pos = jnp.arange(total, dtype=jnp.int32)[None, None, None, :]
 
             def body(carry, tok):
                 views, steps = carry
                 offsets = jnp.minimum(R + steps, total - 1)
                 views_in = [
-                    KVCache(v.keys, v.values, offsets) for v in views
+                    dataclasses.replace(v, length=offsets) for v in views
                 ]
                 pos = prompt_lens + steps
                 prompt_part = kv_pos < prompt_lens[:, None, None, None]
@@ -366,22 +451,9 @@ class PagedDecodeRuntime:
                 body, (views, steps), tokens_blk.T,
             )
             preds = preds.T                           # [n, K]
-            # Pages a K-row write starting at an arbitrary in-page offset
-            # can straddle (traced once per K — the scheduler fixes K).
-            n_wp_verify = (K - 1) // P + 2
-            lp0 = (R + steps0) // P
-            n_rows = jnp.arange(plan.n_slots)
-            new_caches = []
-            for c, v in zip(caches, views):
-                vk = _pages(v.keys)       # [n, pps, P, n_kv, D]
-                vv = _pages(v.values)
-                keys, values = c.keys, c.values
-                for j in range(n_wp_verify):
-                    lp = jnp.clip(lp0 + j, plan.prompt_pages, pps - 1)
-                    phys = page_table[n_rows, lp]
-                    keys = keys.at[phys].set(vk[n_rows, lp])
-                    values = values.at[phys].set(vv[n_rows, lp])
-                new_caches.append(KVCache(keys, values, c.length))
+            new_caches = [
+                _repack(v, c.length) for c, v in zip(caches, views)
+            ]
             return new_caches, preds
 
         def _free_pages(caches, page_mask, slot_mask):
@@ -389,33 +461,53 @@ class PagedDecodeRuntime:
             lengths — the failure-path hard isolation.  Normal completion
             is host-only (unpin + table row → trash): the prefill/decode
             masks and write offsets already keep stale pages unreachable.
+            For int8 the scale rows zero with their pages (a zero scale
+            dequantizes zero codes to exact zeros).
             """
             row = page_mask[:, None, None, None]
-            return [
-                KVCache(
-                    jnp.where(row, jnp.zeros((), c.keys.dtype), c.keys),
-                    jnp.where(row, jnp.zeros((), c.values.dtype), c.values),
-                    jnp.where(slot_mask, 0, c.length),
+            new_caches = []
+            for c in caches:
+                keys = jnp.where(row, jnp.zeros((), c.keys.dtype), c.keys)
+                values = jnp.where(
+                    row, jnp.zeros((), c.values.dtype), c.values
                 )
-                for c in caches
-            ]
+                length = jnp.where(slot_mask, 0, c.length)
+                if quantized:
+                    srow = page_mask[:, None]
+                    new_caches.append(QuantizedKVPages(
+                        keys, values,
+                        jnp.where(srow, 0.0, c.key_scale),
+                        jnp.where(srow, 0.0, c.value_scale),
+                        length,
+                    ))
+                else:
+                    new_caches.append(KVCache(keys, values, length))
+            return new_caches
 
         def _copy_page(caches, src, dst):
             """Copy one physical page ``src → dst`` across every layer —
             the copy-on-write for a prefix hit's partially-filled boundary
             page: the new occupant overwrites its suffix rows in the copy
-            while the original keeps serving other sequences."""
+            while the original keeps serving other sequences.  int8 pages
+            carry their scale rows along."""
+
+            def move(buf):
+                page = jax.lax.dynamic_slice_in_dim(buf, src, 1, axis=0)
+                return jax.lax.dynamic_update_slice(
+                    buf, page, (dst,) + (0,) * (buf.ndim - 1)
+                )
+
             new_caches = []
             for c in caches:
-                pk = jax.lax.dynamic_slice_in_dim(c.keys, src, 1, axis=0)
-                pv = jax.lax.dynamic_slice_in_dim(c.values, src, 1, axis=0)
-                keys = jax.lax.dynamic_update_slice(
-                    c.keys, pk, (dst,) + (0,) * (c.keys.ndim - 1)
-                )
-                values = jax.lax.dynamic_update_slice(
-                    c.values, pv, (dst,) + (0,) * (c.values.ndim - 1)
-                )
-                new_caches.append(KVCache(keys, values, c.length))
+                if quantized:
+                    new_caches.append(QuantizedKVPages(
+                        move(c.keys), move(c.values),
+                        move(c.key_scale), move(c.value_scale), c.length,
+                    ))
+                else:
+                    new_caches.append(
+                        KVCache(move(c.keys), move(c.values), c.length)
+                    )
             return new_caches
 
         self.prefill_chunk = profiled_jit(_prefill_chunk, name="pages.prefill")
@@ -426,22 +518,37 @@ class PagedDecodeRuntime:
 
     # ---------------------------------------------------------------- state
 
-    def init_caches(self, dtype=jnp.bfloat16) -> List[KVCache]:
+    def init_caches(self, dtype=jnp.bfloat16) -> List[Any]:
         """Fresh page pool: ``[n_pages + 1, page_size, n_kv, head_dim]``
         per layer (the +1 row is the trash page) with the monolithic
-        runtime's per-slot write-offset ``length`` kept for bookkeeping."""
+        runtime's per-slot write-offset ``length`` kept for bookkeeping.
+        ``kv_quant="int8"`` pools store int8 codes plus per-(page, row)
+        f32 scale planes (:class:`QuantizedKVPages`)."""
         cfg = self.config
         head_dim = cfg.dim // cfg.n_heads
         plan = self.plan
         shape = (plan.n_pages + 1, plan.page_size, cfg.n_kv_heads, head_dim)
-        caches = [
-            KVCache(
-                keys=jnp.zeros(shape, dtype),
-                values=jnp.zeros(shape, dtype),
-                length=jnp.zeros((plan.n_slots,), jnp.int32),
-            )
-            for _ in range(cfg.n_layers)
-        ]
+        if self.kv_quant == "int8":
+            sshape = (plan.n_pages + 1, plan.page_size)
+            caches = [
+                QuantizedKVPages(
+                    keys=jnp.zeros(shape, jnp.int8),
+                    values=jnp.zeros(shape, jnp.int8),
+                    key_scale=jnp.zeros(sshape, jnp.float32),
+                    value_scale=jnp.zeros(sshape, jnp.float32),
+                    length=jnp.zeros((plan.n_slots,), jnp.int32),
+                )
+                for _ in range(cfg.n_layers)
+            ]
+        else:
+            caches = [
+                KVCache(
+                    keys=jnp.zeros(shape, dtype),
+                    values=jnp.zeros(shape, dtype),
+                    length=jnp.zeros((plan.n_slots,), jnp.int32),
+                )
+                for _ in range(cfg.n_layers)
+            ]
         if self.mesh is not None:
             from music_analyst_tpu.parallel.sharding import shard_kv_caches
 
@@ -449,7 +556,21 @@ class PagedDecodeRuntime:
         return caches
 
     def kv_token_bytes(self, dtype=jnp.bfloat16) -> int:
-        """HBM bytes one cached token costs across all layers (K + V)."""
+        """HBM bytes one cached token costs across all layers (K + V).
+
+        Quantization-aware: under ``kv_quant="int8"`` a token stores
+        int8 codes plus its share of the per-(page, row) f32 scales —
+        one 4-byte scale per token for K and one for V, per layer."""
+        cfg = self.config
+        head_dim = cfg.dim // cfg.n_heads
+        if self.kv_quant == "int8":
+            return 2 * cfg.n_layers * (cfg.n_kv_heads * head_dim + 4)
+        itemsize = jnp.zeros((), dtype).dtype.itemsize
+        return 2 * cfg.n_layers * cfg.n_kv_heads * head_dim * itemsize
+
+    def kv_token_bytes_unquantized(self, dtype=jnp.bfloat16) -> int:
+        """What the same token would cost without KV quantization — the
+        baseline for the manifest's ``kv_quant.bytes_saved``."""
         cfg = self.config
         head_dim = cfg.dim // cfg.n_heads
         itemsize = jnp.zeros((), dtype).dtype.itemsize
@@ -457,6 +578,10 @@ class PagedDecodeRuntime:
 
     def page_bytes(self, dtype=jnp.bfloat16) -> int:
         return self.plan.page_size * self.kv_token_bytes(dtype)
+
+    def pool_bytes(self, dtype=jnp.bfloat16) -> int:
+        """Whole-pool HBM footprint across layers (incl. the trash page)."""
+        return (self.plan.n_pages + 1) * self.page_bytes(dtype)
 
     def compiled_variants(self) -> int:
         """Total compiled-program count across the five programs — the

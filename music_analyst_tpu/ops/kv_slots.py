@@ -47,9 +47,14 @@ the serving stack: the default backend is the prefix-shared *paged*
 runtime (``ops/kv_pages.py``), which keeps the same scheduler, the same
 bit-exactness contract, and the same fixed-program discipline but stores
 KV in a pooled page table so requests sharing a prompt prefix share
-physical pages.  Pin this backend (``--page-size 0``) for decode-heavy
-workloads with no prefix overlap, where the paged gather/scatter
-indirection costs wall time and buys nothing.
+physical pages.  Since ISSUE 18 the paged decode path reads the pool
+through a fused Pallas kernel (``ops/paged_attention.py``) that walks
+the page table in place — the gather/scatter materialization that once
+made decode-heavy no-overlap workloads a reason to pin this backend is
+retired, and the ``continuous`` suite's kernel A/B measures paged
+against this cache directly.  ``--page-size 0`` remains supported as
+the A/B baseline and as the fallback if the kernel path ever needs to
+be ruled out.
 """
 
 from __future__ import annotations

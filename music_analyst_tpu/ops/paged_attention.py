@@ -1,0 +1,412 @@
+"""Fused Pallas paged-attention decode kernel over the shared page pool.
+
+``ops/kv_pages.py`` originally ran decode by materializing a contiguous
+``[n_slots, max_total]`` copy of every slot's KV through the page table
+(gather), running dense attention over the copy, and scattering the
+touched pages back — three extra HBM passes over the whole resident KV
+per decode dispatch, measured at ~25% decode overhead vs the monolithic
+slot runtime on decode-heavy no-prefix workloads (PERFORMANCE.md).  This
+module removes the copy: one fused kernel walks the ``(n_slots,
+pages_per_slot)`` int32 page table *inside* the program, streams KV
+pages through VMEM, and reduces — gather + QK + softmax + V in a single
+``pallas_call``, with the page pool bound as an ``ANY``-space operand so
+no contiguous view is ever materialized.
+
+Two kernel bodies, chosen statically by backend:
+
+* **exact batched body** (interpret mode / the CPU-emulated test mesh):
+  one program over the whole batch; the in-kernel take-gather feeds the
+  *verbatim* ops of the dense reference
+  (``models/layers.dot_product_attention`` over the gathered view) —
+  same ``repeat``-broadcast GQA, same einsum subscripts, same cast/scale
+  order — so interpret-mode lowering is **bitwise** identical to the
+  retired gather path.  (A no-repeat grouped contraction is
+  mathematically equal but reassociates the head broadcast, and a
+  1-ulp logit difference flips greedy argmax near-ties; the streaming
+  TPU body keeps the grouped form since on-chip it IS the lowering.)
+* **streaming body** (real TPU): grid over slots; each program walks its
+  table row, DMAs one page at a time into VMEM scratch
+  (``pltpu.make_async_copy``), and folds it into an online-softmax
+  accumulator (running max / normalizer / weighted-V, masked lanes
+  contribute exact zeros) — O(page) VMEM regardless of context length.
+
+int8 KV pages (``ops/quant.quantize_kv_page``): both bodies accept
+optional per-(page, row) f32 scale pools and fuse the dequant into the
+KV-load epilogue — codes go ``int8 → f32 × scale → bf16`` right after
+the gather/DMA, before QK.  The fp16/bf16 path stays byte-identical to
+the retired gather runtime; int8 carries a bounded-error contract
+instead (``tests/test_paged_attention.py``).
+
+:class:`PagedAttnView` is the cache-shaped adapter: a registered
+dataclass carrying (pool, scales, table, write offsets) that duck-types
+``models/layers.KVCache`` — ``update`` writes the new token's KV row
+directly into its physical page (quantizing per-row for int8) and
+``attend`` invokes the kernel — so the paged decode runtime passes it
+through the unmodified model stack and the whole decode span runs with
+no per-dispatch gather/pad/scatter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Masked logit value.  The exact body uses finfo.min to match the dense
+# reference bitwise; the streaming body's running max starts here and
+# masked lanes are zeroed explicitly, so the sentinel never reaches exp.
+_NEG_INF = -1e30
+
+
+def _geometry(q, key_pages, table, mask):
+    n, q_len, H, D = q.shape
+    if q_len != 1:
+        raise ValueError(
+            f"paged_attention is a decode kernel (q_len == 1), got {q_len}"
+        )
+    P, n_kv = key_pages.shape[1], key_pages.shape[2]
+    if key_pages.shape[3] != D:
+        raise ValueError(
+            f"head_dim mismatch: q has {D}, pages have {key_pages.shape[3]}"
+        )
+    if H % n_kv:
+        raise ValueError(f"n_heads ({H}) not divisible by n_kv ({n_kv})")
+    pps = table.shape[1]
+    total = mask.shape[-1]
+    if total > pps * P:
+        raise ValueError(
+            f"mask width ({total}) exceeds slot span ({pps * P})"
+        )
+    return n, H, n_kv, D, P, pps, total
+
+
+def _dequant(codes, scale, dtype):
+    """int8 codes → compute dtype, scale broadcast over (n_kv, head_dim)."""
+    return (codes.astype(jnp.float32) * scale[..., None, None]).astype(dtype)
+
+
+def _exact_body(n, H, n_kv, D, P, pps, total, quantized, dtype):
+    """One program, whole batch: in-kernel gather + the dense reference.
+
+    Bitwise-identical to dense attention over the gathered contiguous
+    view (tests/test_paged_attention.py pins this at page sizes 8 and
+    16): after the gather, the ops ARE ``dot_product_attention``'s —
+    ``repeat``-broadcast GQA, the same einsum subscripts, fp32 cast
+    before the ``D**-0.5`` scale, ``finfo.min`` masking, softmax cast
+    back to ``q.dtype``.  Any algebraic shortcut here (e.g. contracting
+    groups without the repeat) reassociates multiply-adds, and a 1-ulp
+    logit difference flips greedy argmax near-ties — the byte-identity
+    contract forbids it.
+    """
+    span = pps * P
+    G = H // n_kv
+    att_scale = D ** -0.5
+
+    def body(table_ref, mask_ref, q_ref, kp_ref, vp_ref, *rest):
+        if quantized:
+            ks_ref, vs_ref, o_ref = rest
+        else:
+            (o_ref,) = rest
+        k = jnp.take(kp_ref[:], table_ref[:], axis=0)  # [n, pps, P, kv, D]
+        v = jnp.take(vp_ref[:], table_ref[:], axis=0)
+        if quantized:
+            sk = jnp.take(ks_ref[:], table_ref[:], axis=0)  # [n, pps, P]
+            sv = jnp.take(vs_ref[:], table_ref[:], axis=0)
+            k = _dequant(k, sk, dtype)
+            v = _dequant(v, sv, dtype)
+        k = k.reshape(n, span, n_kv, D)[:, :total]
+        v = v.reshape(n, span, n_kv, D)[:, :total]
+        q = q_ref[:]
+        if n_kv != H:
+            k = jnp.repeat(k, G, axis=2)
+            v = jnp.repeat(v, G, axis=2)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        s = s * att_scale
+        s = jnp.where(
+            mask_ref[:][:, None, None, :total], s, jnp.finfo(jnp.float32).min
+        )
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        o_ref[:] = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    return body
+
+
+def _stream_body(n, H, n_kv, D, P, pps, total, quantized, dtype):
+    """Per-slot program: DMA one page at a time, online-softmax fold.
+
+    The page walk is a static unroll over the slot's table row; each
+    page is copied pool → VMEM scratch with ``make_async_copy`` (the
+    dequant epilogue runs on the scratch block for int8), contributes a
+    ``[H, P]`` logit tile, and folds into the running (max, normalizer,
+    weighted-V) accumulator.  Masked lanes are zeroed *after* the exp,
+    so fully-masked pages (the slack tail past ``total``, a free slot's
+    trash pages) contribute exactly nothing.
+    """
+    G = H // n_kv
+    att_scale = D ** -0.5
+
+    def body(table_ref, mask_ref, q_ref, kp_ref, vp_ref, *rest):
+        if quantized:
+            (ks_ref, vs_ref, o_ref,
+             kbuf, vbuf, ksbuf, vsbuf, sem) = rest
+        else:
+            o_ref, kbuf, vbuf, sem = rest
+        q = q_ref[0, 0]                                    # [H, D]
+        qg = q.reshape(n_kv, G, D)
+        m = jnp.full((H, 1), _NEG_INF, jnp.float32)        # running max
+        l = jnp.zeros((H, 1), jnp.float32)                 # normalizer
+        acc = jnp.zeros((H, D), jnp.float32)               # weighted V
+        for lp in range(pps):
+            phys = table_ref[0, lp]
+            cp = pltpu.make_async_copy(kp_ref.at[phys], kbuf, sem)
+            cp.start()
+            cp.wait()
+            cp = pltpu.make_async_copy(vp_ref.at[phys], vbuf, sem)
+            cp.start()
+            cp.wait()
+            if quantized:
+                cp = pltpu.make_async_copy(ks_ref.at[phys], ksbuf, sem)
+                cp.start()
+                cp.wait()
+                cp = pltpu.make_async_copy(vs_ref.at[phys], vsbuf, sem)
+                cp.start()
+                cp.wait()
+                k = _dequant(kbuf[:], ksbuf[:], dtype)     # [P, n_kv, D]
+                v = _dequant(vbuf[:], vsbuf[:], dtype)
+            else:
+                k = kbuf[:]
+                v = vbuf[:]
+            valid = mask_ref[0, lp * P:(lp + 1) * P]       # [P]
+            s = jnp.einsum("hgd,phd->hgp", qg, k).astype(jnp.float32)
+            s = s.reshape(H, P) * att_scale
+            s = jnp.where(valid[None, :], s, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            p = jnp.where(valid[None, :], p, 0.0)          # exact zeros
+            corr = jnp.exp(m - m_new)
+            l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+            pv = jnp.einsum(
+                "hgp,phd->hgd",
+                p.reshape(n_kv, G, P),
+                v.astype(jnp.float32),
+            )
+            acc = acc * corr + pv.reshape(H, D)
+            m = m_new
+        l = jnp.where(l == 0.0, 1.0, l)                    # all-masked rows
+        o_ref[0, 0] = (acc / l).astype(dtype)
+
+    return body
+
+
+def paged_attention(
+    q: jax.Array,
+    key_pages: jax.Array,
+    value_pages: jax.Array,
+    table: jax.Array,
+    mask: jax.Array,
+    *,
+    key_scale: Optional[jax.Array] = None,
+    value_scale: Optional[jax.Array] = None,
+    interpret: Optional[bool] = None,
+    stream: Optional[bool] = None,
+) -> jax.Array:
+    """Fused paged decode attention: gather + QK + softmax + V, one call.
+
+    Args:
+      q: ``[n_slots, 1, n_heads, head_dim]`` decode queries.
+      key_pages / value_pages: the physical pool,
+        ``[n_pages + 1, page_size, n_kv_heads, head_dim]`` (bf16/fp16, or
+        int8 codes when scales are passed; the +1 row is the trash page).
+      table: ``[n_slots, pages_per_slot]`` int32 physical page indices.
+      mask: ``[n_slots, total]`` bool — True at attendable positions
+        (``total`` fixes the softmax width, exactly as the retired
+        gathered view's ``[:, :total]`` slice did).
+      key_scale / value_scale: optional ``[n_pages + 1, page_size]`` f32
+        per-(page, row) symmetric dequant scales; passing them selects
+        the int8 path with dequant fused after the KV load.
+      interpret: run the Pallas interpreter (defaults to "not on TPU" —
+        the CPU-emulated test mesh always interprets).
+      stream: pick the page-streaming online-softmax body (defaults to
+        the exact batched body under interpret, streaming on TPU; tests
+        force ``stream=True`` under interpret to cover the TPU body).
+
+    Returns ``[n_slots, 1, n_heads, head_dim]`` in ``q.dtype``.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if stream is None:
+        stream = not interpret
+    quantized = key_scale is not None
+    if quantized != (value_scale is not None):
+        raise ValueError("key_scale and value_scale must be passed together")
+    n, H, n_kv, D, P, pps, total = _geometry(q, key_pages, table, mask)
+    span = pps * P
+    if stream and total < span:
+        # The streaming body walks whole pages; pad the mask so the
+        # slack tail past ``total`` is just more masked lanes.
+        mask = jnp.pad(mask, ((0, 0), (0, span - total)))
+    dtype = q.dtype
+    operands = [table, mask, q, key_pages, value_pages]
+    pool_specs = [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+    if quantized:
+        operands += [key_scale, value_scale]
+        pool_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+    if not stream:
+        body = _exact_body(n, H, n_kv, D, P, pps, total, quantized, dtype)
+        return pl.pallas_call(
+            body,
+            out_shape=jax.ShapeDtypeStruct((n, 1, H, D), dtype),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),   # table
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # mask
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # q
+                *pool_specs,
+            ],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            interpret=interpret,
+        )(*operands)
+    body = _stream_body(n, H, n_kv, D, P, pps, total, quantized, dtype)
+    mask_w = mask.shape[-1]
+    scratch = [
+        pltpu.VMEM((P, n_kv, D), key_pages.dtype),
+        pltpu.VMEM((P, n_kv, D), value_pages.dtype),
+    ]
+    if quantized:
+        scratch += [
+            pltpu.VMEM((P,), key_scale.dtype),
+            pltpu.VMEM((P,), value_scale.dtype),
+        ]
+    scratch.append(pltpu.SemaphoreType.DMA)
+    return pl.pallas_call(
+        body,
+        out_shape=jax.ShapeDtypeStruct((n, 1, H, D), dtype),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, pps), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (1, mask_w), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, 1, H, D), lambda i: (i, 0, 0, 0), memory_space=pltpu.VMEM
+            ),
+            *pool_specs,
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, H, D), lambda i: (i, 0, 0, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*operands)
+
+
+def paged_attention_reference(
+    q, key_pages, value_pages, table, mask, key_scale=None, value_scale=None
+):
+    """Naive f32 oracle: gather pool rows through the table, dequantize,
+    broadcast KV heads over query groups, full-precision softmax.  The
+    property tests (``tests/test_paged_attention.py``) compare both
+    kernel bodies against this across page sizes, odd valid lengths,
+    and trash-page table rows."""
+    n, H, n_kv, D, P, pps, total = _geometry(q, key_pages, table, mask)
+    span = pps * P
+    k = jnp.take(key_pages, table, axis=0)
+    v = jnp.take(value_pages, table, axis=0)
+    if key_scale is not None:
+        k = _dequant(k, jnp.take(key_scale, table, axis=0), jnp.float32)
+        v = _dequant(v, jnp.take(value_scale, table, axis=0), jnp.float32)
+    k = k.reshape(n, span, n_kv, D)[:, :total].astype(jnp.float32)
+    v = v.reshape(n, span, n_kv, D)[:, :total].astype(jnp.float32)
+    group = H // n_kv
+    k = jnp.repeat(k, group, axis=2)
+    v = jnp.repeat(v, group, axis=2)
+    q32 = q.astype(jnp.float32)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q32, k) * (D ** -0.5)
+    logits = jnp.where(
+        mask[:, None, None, :], logits, jnp.finfo(jnp.float32).min
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@dataclasses.dataclass
+class PagedAttnView:
+    """KVCache-shaped adapter binding one decode step to the page pool.
+
+    Carries the physical pool (codes + scales for int8), the page table,
+    and per-slot write offsets; duck-types ``models/layers.KVCache`` so
+    the unmodified model stack drives the fused kernel: ``update`` lands
+    the step's new KV row directly in its physical page (``off // P``
+    within the slot's row, quantized per-row for int8) and ``attend``
+    runs :func:`paged_attention` — the pool IS the cache, so the decode
+    scan carries it and the runtime never gathers or scatters a view.
+    """
+
+    keys: jax.Array                      # [n_pages + 1, P, n_kv, D]
+    values: jax.Array
+    key_scale: Optional[jax.Array]       # [n_pages + 1, P] f32, int8 only
+    value_scale: Optional[jax.Array]
+    table: jax.Array                     # [n_slots, pages_per_slot] int32
+    length: jax.Array                    # [n_slots] int32 write offsets
+    page_size: int = 16
+    total: int = 0
+
+    def update(self, k_new: jax.Array, v_new: jax.Array) -> "PagedAttnView":
+        if k_new.shape[1] != 1:
+            raise ValueError(
+                "PagedAttnView writes one decode token per step "
+                f"(got {k_new.shape[1]}); chunked prefill stays on the "
+                "gather/scatter path (ops/kv_pages.py)"
+            )
+        P = self.page_size
+        rows = jnp.arange(self.table.shape[0])
+        off = self.length
+        lp = off // P
+        r = off % P
+        # Free slots' rows all point at the trash page; their duplicate
+        # writes race benignly (the page is never read through an active
+        # mask).  Decode offsets sit at or past prompt_region, so lp
+        # lands in the decode pages and shared prompt pages are never
+        # written (the invariant the retired scatter clamped for).
+        phys = self.table[rows, lp]
+        if self.key_scale is None:
+            keys = self.keys.at[phys, r].set(
+                k_new[:, 0].astype(self.keys.dtype)
+            )
+            values = self.values.at[phys, r].set(
+                v_new[:, 0].astype(self.values.dtype)
+            )
+            key_scale = value_scale = None
+        else:
+            from music_analyst_tpu.ops.quant import quantize_kv_page
+
+            qk, sk = quantize_kv_page(k_new[:, 0])
+            qv, sv = quantize_kv_page(v_new[:, 0])
+            keys = self.keys.at[phys, r].set(qk)
+            values = self.values.at[phys, r].set(qv)
+            key_scale = self.key_scale.at[phys, r].set(sk)
+            value_scale = self.value_scale.at[phys, r].set(sv)
+        return dataclasses.replace(
+            self, keys=keys, values=values,
+            key_scale=key_scale, value_scale=value_scale, length=off + 1,
+        )
+
+    def attend(self, q: jax.Array, mask: jax.Array) -> jax.Array:
+        """Decode attention for ``q [n, 1, H, D]`` under ``mask
+        [n, 1, 1, total]`` — the fused kernel, no materialized view."""
+        return paged_attention(
+            q, self.keys, self.values, self.table, mask[:, 0, 0, :],
+            key_scale=self.key_scale, value_scale=self.value_scale,
+        )
+
+
+jax.tree_util.register_dataclass(
+    PagedAttnView,
+    data_fields=[
+        "keys", "values", "key_scale", "value_scale", "table", "length"
+    ],
+    meta_fields=["page_size", "total"],
+)

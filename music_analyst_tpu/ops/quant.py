@@ -107,6 +107,57 @@ def quant_dense_axis_last2(x, kernel, bias=None, out_dtype=None):
 
 
 # ---------------------------------------------------------------------------
+# int8 KV-page quantization (paged decode cache, ops/paged_attention.py)
+# ---------------------------------------------------------------------------
+#
+# KV rows are quantized symmetrically **per (page, row)**: one f32 scale
+# covers a single token's (n_kv_heads, head_dim) K or V block.  Per-row
+# granularity is what makes incremental decode exact — each new token's
+# row is quantized once, in isolation, when it is written, so committing
+# a token never re-scales (and never perturbs) any previously-written
+# row, and copy-on-write / checkpoint / pin-transfer paths can move
+# pages plus their scale rows without ever recomputing anything.  The
+# dequant (codes · scale) is fused into the paged-attention kernel's
+# KV-load epilogue; the scale layout alongside the pool is
+# ``[n_pages + 1, page_size]`` per layer, for K and V each.
+
+
+def quantize_kv_page(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric int8 over the trailing ``(n_kv_heads, head_dim)`` axes.
+
+    ``x [..., n_kv, D]`` → ``(codes int8 [..., n_kv, D], scale f32
+    [...])`` with ``scale = max(|row|, 1e-8) / 127`` — the same scheme as
+    the matmul paths above, at per-token granularity.  Round-trip
+    contract (pinned by tests/test_paged_attention.py): quantizing a row
+    dequantized to f32 reproduces the codes exactly (the scale
+    reconstructs to within 1 ulp and ``127 · 2^-24 ≪ 0.5``); through the
+    bf16 compute dtype the reconstruction error reaches ``127 · 2^-8 ≈
+    0.5``, so a code can shift by at most ±1 on the first round-trip and
+    the result is a fixed point of further round-trips.  The paged
+    prefill's recompute-and-rescatter of a boundary page therefore
+    perturbs already-written rows by ≤ 1 code once — inside the int8
+    path's bounded-error budget (the byte-identity contract covers only
+    the unquantized pools).
+    """
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=(-2, -1))
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(x32 / scale[..., None, None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv_page(
+    q: jax.Array, scale: jax.Array, dtype=jnp.bfloat16
+) -> jax.Array:
+    """Inverse of :func:`quantize_kv_page`: ``codes [..., n_kv, D]`` ×
+    ``scale [...]`` → ``dtype`` rows (the representation the model's
+    attention math runs on everywhere else)."""
+    return (q.astype(jnp.float32) * scale[..., None, None]).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
 # Weight-only quantized parameter store (stored int8 / packed int4 weights)
 # ---------------------------------------------------------------------------
 #

@@ -94,15 +94,27 @@ def shard_kv_caches(caches, mesh: Mesh, n_kv_heads: int):
     kv_spec, len_spec = kv_cache_spec(mesh, n_kv_heads)
     kv_sh = NamedSharding(mesh, kv_spec)
     len_sh = NamedSharding(mesh, len_spec)
-    return [
-        dataclasses.replace(
-            c,
-            keys=jax.device_put(c.keys, kv_sh),
-            values=jax.device_put(c.values, kv_sh),
-            length=jax.device_put(c.length, len_sh),
+    out = []
+    for c in caches:
+        extra = {}
+        if getattr(c, "key_scale", None) is not None:
+            # int8 paged pools (ops/kv_pages.QuantizedKVPages) carry
+            # per-(page, row) scale planes: no head axis, so they
+            # replicate like the lengths.
+            extra = dict(
+                key_scale=jax.device_put(c.key_scale, len_sh),
+                value_scale=jax.device_put(c.value_scale, len_sh),
+            )
+        out.append(
+            dataclasses.replace(
+                c,
+                keys=jax.device_put(c.keys, kv_sh),
+                values=jax.device_put(c.values, kv_sh),
+                length=jax.device_put(c.length, len_sh),
+                **extra,
+            )
         )
-        for c in caches
-    ]
+    return out
 
 
 def spec_for_path(path: str, rules=None) -> P:

@@ -80,6 +80,11 @@ SITES = frozenset(
         # scrape marks the series stale and counts scrape_errors —
         # serving bytes and replies are never affected.
         "metrics.scrape",
+        # int8 KV-page dequantization (serving/decode_loop.py): a fault
+        # here degrades the scheduler to the unquantized paged pool at
+        # construction time — replies stay byte-identical, the stats
+        # block flags ``kv_quant.degraded``.
+        "kv_quant.dequant",
     }
 )
 

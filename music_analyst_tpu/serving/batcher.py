@@ -69,6 +69,12 @@ DEFAULT_PREFILL_CHUNK = 64
 # auto: n_slots * pages_per_slot, i.e. no oversubscription).
 DEFAULT_PAGE_SIZE = 16
 DEFAULT_KV_PAGES = 0
+# KV-page quantization (ops/kv_pages.py): "none" stores pages at the
+# compute dtype; "int8" stores per-(page, row) symmetric int8 codes plus
+# f32 scales, dequantized inside the paged-attention kernel's KV-load
+# epilogue.  Requires the paged backend (page_size > 0).
+DEFAULT_KV_QUANT = "none"
+KV_QUANT_CHOICES = ("none", "int8")
 # Speculative decoding (serving/decode_loop.py): max draft tokens the
 # host self-drafter proposes per slot per verify dispatch (0 = off,
 # plain one-token-per-step decode).
@@ -179,6 +185,26 @@ def resolve_page_size(value: Any = None) -> int:
             )
         return DEFAULT_PAGE_SIZE
     return page
+
+
+def resolve_kv_quant(value: Any = None) -> str:
+    """KV-page quantization scheme (``--kv-quant`` /
+    ``$MUSICAAL_SERVE_KV_QUANT``): ``none`` or ``int8``.
+
+    An explicit unknown scheme raises (usage error); an unknown env
+    value falls back to the default, like every other malformed serve
+    env var.
+    """
+    if value is None:
+        raw = os.environ.get("MUSICAAL_SERVE_KV_QUANT", "").strip().lower()
+        return raw if raw in KV_QUANT_CHOICES else DEFAULT_KV_QUANT
+    scheme = str(value).strip().lower()
+    if scheme not in KV_QUANT_CHOICES:
+        raise ValueError(
+            f"kv_quant must be one of {'/'.join(KV_QUANT_CHOICES)}, "
+            f"got {value!r}"
+        )
+    return scheme
 
 
 def resolve_speculate_k(value: Any = None) -> int:

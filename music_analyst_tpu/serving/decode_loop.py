@@ -104,7 +104,7 @@ import numpy as np
 
 from music_analyst_tpu.observability import watchdog
 from music_analyst_tpu.ops.kv_pages import PagePool, RadixIndex
-from music_analyst_tpu.resilience.faults import fault_point
+from music_analyst_tpu.resilience.faults import fault_point, InjectedFault
 from music_analyst_tpu.resilience.policy import RetryPolicy
 from music_analyst_tpu.serving.batcher import (
     _LATENCY_BUCKETS,
@@ -114,6 +114,7 @@ from music_analyst_tpu.serving.batcher import (
     DEFAULT_TENANT,
     ServeRequest,
     resolve_kv_pages,
+    resolve_kv_quant,
     resolve_max_queue,
     resolve_page_size,
     resolve_prefill_chunk,
@@ -283,6 +284,7 @@ class ContinuousScheduler:
         max_queue: Optional[int] = None,
         page_size: Optional[int] = None,
         kv_pages: Optional[int] = None,
+        kv_quant: Optional[str] = None,
         prefix_cache: bool = True,
         ttft_slo_ms: Optional[float] = None,
         tpot_slo_ms: Optional[float] = None,
@@ -309,6 +311,23 @@ class ContinuousScheduler:
         ))
         page = resolve_page_size(page_size)
         self.paged = bool(page) and hasattr(backend, "paged_runtime")
+        self.kv_quant = resolve_kv_quant(kv_quant)
+        self._kv_quant_degraded = False
+        if self.kv_quant != "none" and not self.paged:
+            raise ValueError(
+                "kv_quant requires the paged KV backend; it cannot combine "
+                "with --page-size 0 (the monolithic slot cache)"
+            )
+        if self.kv_quant != "none":
+            # Degrade seam: a fault here (site ``kv_quant.dequant``)
+            # means the quantized read path is unavailable — fall back to
+            # the unquantized pool *before* any page is written, so every
+            # reply is byte-identical to an unquantized scheduler's.
+            try:
+                fault_point("kv_quant.dequant", scheme=self.kv_quant)
+            except InjectedFault:
+                self.kv_quant = "none"
+                self._kv_quant_degraded = True
         if self.paged:
             self.runtime = backend.paged_runtime(
                 n_slots=self.n_slots,
@@ -318,6 +337,7 @@ class ContinuousScheduler:
                 decode_span=decode_span,
                 page_size=page,
                 kv_pages=resolve_kv_pages(kv_pages, self.n_slots),
+                kv_quant=self.kv_quant,
             )
         else:
             self.runtime = backend.slot_runtime(
@@ -567,6 +587,7 @@ class ContinuousScheduler:
                 page_size=self.plan.page_size,
                 kv_pages=self.plan.n_pages,
                 pages_per_slot=self.plan.pages_per_slot,
+                kv_quant=self.kv_quant,
             )
         self._warmup_record = record
         tel.annotate(decode_warmup=record)
@@ -1895,12 +1916,37 @@ class ContinuousScheduler:
                 ),
                 hbm_bytes_per_seq_unshared=plan.pages_per_slot * page_bytes,
             )
+            # KV quantization accounting: the pool's resident bytes under
+            # the active scheme vs the bf16 layout it replaces.  The
+            # byte counters above (kv_token_bytes / page_bytes /
+            # hbm_bytes_per_seq) are already scheme-aware — int8 counts
+            # codes plus the per-(page, row) f32 scales.
+            pool_bytes = self.runtime.pool_bytes()
+            unq_ratio = (
+                self.runtime.kv_token_bytes_unquantized()
+                / self.runtime.kv_token_bytes()
+            )
+            pool_unq = round(pool_bytes * unq_ratio)
             out.update(
                 page_size=plan.page_size,
                 kv_pages=plan.n_pages,
                 pages_per_slot=plan.pages_per_slot,
                 page_bytes=page_bytes,
                 prefix_cache=prefix,
+                kv_quant={
+                    "scheme": self.kv_quant,
+                    "degraded": self._kv_quant_degraded,
+                    "pool_bytes": pool_bytes,
+                    "pool_bytes_unquantized": pool_unq,
+                    "bytes_saved": pool_unq - pool_bytes,
+                    "hbm_bytes_per_seq": (
+                        plan.pages_per_slot * page_bytes
+                    ),
+                    "hbm_bytes_per_seq_unquantized": round(
+                        plan.pages_per_slot * page_bytes * unq_ratio
+                    ),
+                    "compression": round(unq_ratio, 4),
+                },
             )
         return out
 

@@ -654,6 +654,7 @@ def run_server(
     max_new_tokens: int = 16,
     page_size: Optional[int] = None,
     kv_pages: Optional[int] = None,
+    kv_quant: Optional[str] = None,
     speculate_k: Optional[int] = None,
     tp: Optional[int] = None,
     ttft_slo_ms: Optional[float] = None,
@@ -756,6 +757,7 @@ def run_server(
                 max_queue=max_queue,
                 page_size=page_size,
                 kv_pages=kv_pages,
+                kv_quant=kv_quant,
                 speculate_k=speculate_k,
                 ttft_slo_ms=ttft_slo_ms,
                 tpot_slo_ms=tpot_slo_ms,

@@ -292,26 +292,23 @@ def test_identical_inflight_generates_fold_to_one_slot(clf):
 @pytest.mark.slow
 def test_continuous_suite_speculation_bar(monkeypatch):
     """The continuous suite's speculation A/B booleans ARE the ISSUE-15
-    bar: ≥2× decode tokens/s on the chorus-like smoke workload,
-    byte-identical greedy text, strictly fewer decode dispatches, zero
-    retraces."""
+    bar: ≥2× fewer decode dispatches on the chorus-like smoke workload,
+    byte-identical greedy text, zero retraces.
+
+    The gated ratio is the dispatch count — a deterministic function of
+    the accepted-draft lengths, immune to the sandbox's wall-clock
+    noise — so one attempt suffices (ISSUE 18 retired the retry-up-to-3
+    workaround the old tokens/s bar needed)."""
     monkeypatch.setenv("MUSICAAL_BENCH_SMOKE", "1")
     from benchmarks.continuous import _speculation_ab
 
-    # The wall-clock bar sits near 2.1-2.4x in isolation on the 1-core
-    # sandbox but can dip under 2x late in a full-suite run; the
-    # structural booleans must hold on EVERY attempt — only the timing
-    # ratio gets retries.
-    for attempt in range(3):
-        row = _speculation_ab(
-            n_requests=16, n_slots=8, budget=128, speculate_k=8
-        )
-        assert row["identical_outputs"] is True
-        assert row["fewer_dispatches"] is True
-        assert row["zero_retrace"] is True
-        if row["speedup_ok"]:
-            break
-    assert row["speedup_ok"] is True, row
+    row = _speculation_ab(
+        n_requests=16, n_slots=8, budget=128, speculate_k=8
+    )
+    assert row["identical_outputs"] is True
+    assert row["fewer_dispatches"] is True
+    assert row["zero_retrace"] is True
+    assert row["dispatch_ratio_ok"] is True, row
 
 
 # ------------------------------------------------------------- reporting

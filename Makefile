@@ -113,6 +113,45 @@ print("burn-rate self-check ok: shed_burn_rate tenant=bulk,",
 endef
 export BURN_SELFCHECK
 
+# Engine-ledger self-check body (exported below; run with $(PY) -c
+# "$$LEDGER_SELFCHECK" <replies.ndjson> <profile-dir>): after a stdio
+# generate burst over two tenants, every reply must be ok, the stats op
+# must surface the live ledger, and the final cumulative record in
+# engine_ledger.jsonl must tile — classified seconds covering >=95% of
+# the engine wall and per-tenant chip-seconds summing to the wall within
+# 2% — with zero flush drops and no torn line.
+define LEDGER_SELFCHECK
+import json, os, sys
+replies_path, profile_dir = sys.argv[1], sys.argv[2]
+replies = [json.loads(l) for l in open(replies_path) if l.strip()]
+by_id = {r["id"]: r for r in replies}
+gen = [r for r in replies if r["id"] != "end"]
+assert gen and all(r.get("ok") for r in gen), \
+    [r for r in gen if not r.get("ok")]
+live = ((by_id["end"].get("stats") or {}).get("decode") or {}).get(
+    "ledger") or {}
+assert live.get("ticks", 0) > 0, f"stats op carries no live ledger: {live}"
+path = os.path.join(profile_dir, "engine_ledger.jsonl")
+raw = open(path, "rb").read()
+assert raw.endswith(b"\n"), "torn final line in engine_ledger.jsonl"
+recs = [json.loads(l) for l in raw.decode("utf-8").splitlines()
+        if l.strip()]
+assert recs and all(r.get("type") == "ledger" for r in recs), recs[:2]
+final = recs[-1]["ledger"]
+wall = final["engine_wall_s"]
+assert wall > 0.0, final
+covered = sum(final["seconds"].values())
+assert covered >= 0.95 * wall, (covered, wall)
+chip = sum(final["chip_seconds"].values())
+assert abs(chip - wall) <= 0.02 * wall, (chip, wall)
+assert final["ledger_drops"] == 0, final
+print("engine-ledger self-check ok:",
+      f"{len(recs)} flush(es), coverage {covered / wall:.3f},",
+      "chip-seconds within",
+      f"{abs(chip - wall) / max(wall, 1e-9) * 100.0:.2f}% of wall")
+endef
+export LEDGER_SELFCHECK
+
 # Paged-attention kernel self-check body (exported below; run with
 # $(PY) -c "$$KERNEL_SELFCHECK"): random pool/table/mask with odd valid
 # lengths and a trash-page table row, both Pallas bodies (exact batched
@@ -173,7 +212,8 @@ smoke:
 		tests/test_kv_pages.py tests/test_paged_attention.py \
 		tests/test_router.py \
 		tests/test_journal.py tests/test_speculative.py \
-		tests/test_reqtrace.py tests/test_metrics_plane.py -q
+		tests/test_reqtrace.py tests/test_metrics_plane.py \
+		tests/test_engine_ledger.py tests/test_fault_coverage.py -q
 	# paged-attention kernel self-check (body in KERNEL_SELFCHECK above):
 	# both interpret-mode kernel bodies + the int8 path vs the f32 oracle.
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
@@ -492,6 +532,26 @@ print('smoke ok:', payload['metric'], payload['value'])"
 		{ kill $$srvpid 2>/dev/null; echo "monitor self-check failed"; exit 1; }; \
 	kill $$srvpid 2>/dev/null; wait $$srvpid 2>/dev/null; \
 	echo "monitor self-check ok"
+	# engine-ledger self-check (body in LEDGER_SELFCHECK above): a stdio
+	# generate burst over two tenants on the continuous scheduler, ledger
+	# flushing on a 100ms cadence to the profile dir — the goodput
+	# accounting must tile (coverage >= 0.95, chip-seconds within 2% of
+	# the engine wall) and the JSONL must land intact.
+	ledgertmp=$$(mktemp -d) && trap 'rm -rf "$$ledgertmp"' EXIT && \
+	{ for i in 0 1 2 3 4 5; do \
+		case $$(( i % 2 )) in 0) t=gold;; *) t=bulk;; esac; \
+		printf '{"id":"g%s","op":"generate","text":"verse %s of the burst","tenant":"%s","max_new_tokens":4}\n' "$$i" "$$i" "$$t"; \
+	done; \
+	printf '%s\n' '{"id":"end","op":"stats"}'; } | \
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+		MUSICAAL_LEDGER_INTERVAL_MS=100 \
+		$(PY) -m music_analyst_tpu serve --stdio --model llama-tiny --quiet \
+		--slots 2 --prefill-chunk 32 --max-new-tokens 4 \
+		--max-batch 2 --max-wait-ms 2 --profile-dir "$$ledgertmp" \
+		> "$$ledgertmp/replies.ndjson" || \
+		{ echo "engine-ledger serve run failed"; exit 1; }; \
+	$(PY) -c "$$LEDGER_SELFCHECK" "$$ledgertmp/replies.ndjson" "$$ledgertmp" || \
+		{ echo "engine-ledger self-check failed"; exit 1; }
 
 test:
 	$(PY) -m pytest tests/ -q

@@ -732,6 +732,300 @@ def _kv_quant_scenario(n_requests: int) -> dict:
     }
 
 
+def _ledger_jsonl_intact(path: str):
+    """(intact, n_lines): every line newline-terminated and parseable —
+    the engine ledger's O_APPEND single-write contract, same discipline
+    the metrics plane is held to."""
+    if not os.path.exists(path):
+        return True, 0
+    n = 0
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            if not line.endswith("\n"):
+                return False, n
+            try:
+                json.loads(line)
+            except json.JSONDecodeError:
+                return False, n
+            n += 1
+    return True, n
+
+
+def _ledger_flush_scenario(n_requests: int) -> dict:
+    """Injected engine-ledger flush failure (site ``ledger.flush``):
+    every JSONL append attempt fails, so the ledger degrades to counted
+    ``ledger_drops`` — the generated bytes are identical to the clean
+    flushing run, in-memory attribution keeps accumulating, and no torn
+    ``engine_ledger.jsonl`` line ever lands (a failed flush writes
+    nothing at all)."""
+    from music_analyst_tpu.models.llama import (
+        LlamaConfig,
+        LlamaZeroShotClassifier,
+    )
+    from music_analyst_tpu.observability.engine_ledger import LEDGER_FILE
+    from music_analyst_tpu.resilience import configure_faults, fault_stats
+    from music_analyst_tpu.serving.decode_loop import ContinuousScheduler
+
+    clf = LlamaZeroShotClassifier(
+        config=LlamaConfig.tiny(), max_prompt_len=64
+    )
+    prompts = [f"ledger chaos lyric {i}" for i in range(n_requests)]
+
+    def _run(tag: str, out_dir: str):
+        sched = ContinuousScheduler(
+            clf, n_slots=2, prefill_chunk=16, prompt_region=64,
+            max_new_tokens=4, max_queue=n_requests + 1,
+            ledger_interval_ms=10, ledger_dir=out_dir,
+        )
+        sched.warmup()
+        reqs = [
+            sched.submit(f"{tag}-{i}", p, max_new_tokens=4)
+            for i, p in enumerate(prompts)
+        ]
+        sched.drain()  # synchronous: finishes the backlog, final flush
+        texts = []
+        for req in reqs:
+            resp = req.response or {}
+            if not resp.get("ok"):
+                raise RuntimeError(f"generate {req.id} failed: "
+                                   f"{resp.get('error')}")
+            texts.append(resp["text"])
+        return texts, sched.stats()["ledger"]
+
+    with tempfile.TemporaryDirectory(prefix="chaos_ledger_") as base:
+        clean_dir = os.path.join(base, "clean")
+        faulted_dir = os.path.join(base, "faulted")
+        os.makedirs(clean_dir)
+        os.makedirs(faulted_dir)
+        start = time.perf_counter()
+        clean_texts, clean_snap = _run("clean", clean_dir)
+        configure_faults("ledger.flush:error@1+")
+        try:
+            faulted_texts, faulted_snap = _run("faulted", faulted_dir)
+            trips = fault_stats()["ledger.flush"]["trips"]
+        finally:
+            configure_faults(None)
+        elapsed = time.perf_counter() - start
+        clean_intact, clean_lines = _ledger_jsonl_intact(
+            os.path.join(clean_dir, LEDGER_FILE)
+        )
+        faulted_intact, faulted_lines = _ledger_jsonl_intact(
+            os.path.join(faulted_dir, LEDGER_FILE)
+        )
+    return {
+        "scenario": "ledger_flush_fault",
+        "spec": "ledger.flush:error@1+",
+        "requests": n_requests,
+        "bytes_identical": faulted_texts == clean_texts,
+        "flushes_clean": clean_snap["flushes"],
+        "ledger_drops": faulted_snap["ledger_drops"],
+        "trips": trips,
+        "clean_file_intact": clean_intact,
+        "clean_file_lines": clean_lines,
+        "faulted_file_lines": faulted_lines,
+        "degraded_to_drops": (
+            clean_snap["flushes"] >= 1
+            and clean_snap["ledger_drops"] == 0
+            and clean_intact and clean_lines == clean_snap["flushes"]
+            and faulted_snap["flushes"] == 0
+            and faulted_snap["ledger_drops"] == trips
+            and trips > 0
+            and faulted_snap["ticks"] > 0  # accounting survived the drops
+            and faulted_intact and faulted_lines == 0
+        ),
+        "wall_s": round(elapsed, 4),
+    }
+
+
+def _cache_publish_scenario() -> dict:
+    """Injected cache-publish failure (site ``corpus_cache.publish``): a
+    transient rename fault on the weight-quantization cache's atomic
+    publish is retried in place — the entry still lands, readable, with
+    a counted recovery."""
+    import numpy as np
+
+    from music_analyst_tpu.engines.wq_cache import WqCacheWriter
+    from music_analyst_tpu.resilience import (
+        configure_faults,
+        fault_stats,
+        reset_retry_stats,
+        retry_stats,
+    )
+
+    reset_retry_stats()
+    with tempfile.TemporaryDirectory(prefix="chaos_wqcache_") as base:
+        configure_faults("corpus_cache.publish:error@1")
+        try:
+            start = time.perf_counter()
+            writer = WqCacheWriter(base, "chaos-entry")
+            writer.add("layer/kernel", np.ones((2, 2), np.float32))
+            published = writer.publish()
+            elapsed = time.perf_counter() - start
+            trips = fault_stats()["corpus_cache.publish"]["trips"]
+        finally:
+            configure_faults(None)
+    counts = retry_stats().get("corpus_cache.publish", {})
+    return {
+        "scenario": "cache_publish_transient",
+        "spec": "corpus_cache.publish:error@1",
+        "published": bool(published),
+        "trips": trips,
+        "recoveries": counts.get("recoveries", 0),
+        "recovered": bool(published) and trips == 1
+        and counts.get("recoveries", 0) >= 1,
+        "wall_s": round(elapsed, 4),
+    }
+
+
+def _compile_first_scenario() -> dict:
+    """Injected first-compile failure (site ``compile.first``): the
+    profiled-jit wrapper retries the lower/compile under its backoff
+    policy, so a transient compiler-side failure costs one retry — the
+    compiled result is numerically identical to a clean compile."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from music_analyst_tpu.profiling.compile import profiled_jit
+    from music_analyst_tpu.resilience import (
+        configure_faults,
+        fault_stats,
+        reset_retry_stats,
+        retry_stats,
+    )
+
+    reset_retry_stats()
+    x = jnp.arange(16, dtype=jnp.float32)
+    clean = np.asarray(profiled_jit(
+        lambda v: v * 3.0 + 1.0, name="chaos_compile_clean"
+    )(x))
+    configure_faults("compile.first:error@1")
+    try:
+        start = time.perf_counter()
+        faulted = np.asarray(profiled_jit(
+            lambda v: v * 3.0 + 1.0, name="chaos_compile_faulted"
+        )(x))
+        elapsed = time.perf_counter() - start
+        trips = fault_stats()["compile.first"]["trips"]
+    finally:
+        configure_faults(None)
+    counts = retry_stats().get("compile.first", {})
+    return {
+        "scenario": "compile_first_transient",
+        "spec": "compile.first:error@1",
+        "bytes_identical": bool(np.array_equal(clean, faulted)),
+        "trips": trips,
+        "recoveries": counts.get("recoveries", 0),
+        "recovered": trips == 1 and counts.get("recoveries", 0) >= 1
+        and bool(np.array_equal(clean, faulted)),
+        "wall_s": round(elapsed, 4),
+    }
+
+
+def _checkpoint_stream_scenario() -> dict:
+    """Injected checkpoint-stream faults (sites ``checkpoint.load`` and
+    ``h2d.transfer``): one transient trip on each stage of the streaming
+    weight loader — the prefetch pipeline's per-stage retry re-runs the
+    unit from scratch and the loaded tree is identical to a clean load."""
+    import jax
+    import numpy as np
+
+    from music_analyst_tpu.engines.checkpoint import load_quantized_params
+    from music_analyst_tpu.resilience import configure_faults, fault_stats
+
+    rng = np.random.default_rng(7)
+    weights = {
+        f"layer{i}": {
+            "kernel": rng.standard_normal((8, 8)).astype(np.float32)
+        }
+        for i in range(3)
+    }
+
+    def _unit_source():
+        for unit, tree in weights.items():
+            yield unit, [(f"{unit}/kernel", tree["kernel"])]
+
+    def _leaves(tree):
+        return [np.asarray(leaf) for leaf in jax.tree_util.tree_leaves(tree)]
+
+    clean = _leaves(load_quantized_params(weights, _unit_source, "int8"))
+    spec = "checkpoint.load:error@1;h2d.transfer:error@1"
+    configure_faults(spec)
+    try:
+        start = time.perf_counter()
+        faulted = _leaves(load_quantized_params(weights, _unit_source, "int8"))
+        elapsed = time.perf_counter() - start
+        stats = fault_stats()
+        trips = sum(int(stats[s]["trips"])
+                    for s in ("checkpoint.load", "h2d.transfer"))
+    finally:
+        configure_faults(None)
+    identical = len(clean) == len(faulted) and all(
+        np.array_equal(a, b) for a, b in zip(clean, faulted)
+    )
+    return {
+        "scenario": "checkpoint_stream_transient",
+        "spec": spec,
+        "bytes_identical": identical,
+        "trips": trips,
+        "recovered": trips == 2 and identical,
+        "wall_s": round(elapsed, 4),
+    }
+
+
+def _ollama_request_scenario() -> dict:
+    """Injected HTTP failure (site ``ollama.request``): the classifier's
+    network retry absorbs a transient request fault — the batch still
+    labels every row (the reference implementation dies on the first
+    HTTP error; SURVEY.md §5).  The endpoint is a stub: chaos runs under
+    zero egress."""
+    import requests
+
+    from music_analyst_tpu.models.ollama import OllamaClassifier
+    from music_analyst_tpu.resilience import (
+        configure_faults,
+        fault_stats,
+        reset_retry_stats,
+        retry_stats,
+    )
+
+    class _StubResponse:
+        status_code = 200
+
+        def raise_for_status(self) -> None:
+            return None
+
+        @staticmethod
+        def json():
+            return {"response": "Positive"}
+
+    reset_retry_stats()
+    clf = OllamaClassifier(
+        model="chaos-stub", retries=2, backoff_seconds=0.01
+    )
+    real_post = requests.post
+    requests.post = lambda *args, **kwargs: _StubResponse()
+    configure_faults("ollama.request:error@1")
+    try:
+        start = time.perf_counter()
+        labels = clf.classify_batch(["happy happy chaos song"])
+        elapsed = time.perf_counter() - start
+        trips = fault_stats()["ollama.request"]["trips"]
+    finally:
+        requests.post = real_post
+        configure_faults(None)
+    counts = retry_stats().get("ollama.request", {})
+    return {
+        "scenario": "ollama_request_transient",
+        "spec": "ollama.request:error@1",
+        "labels": labels,
+        "trips": trips,
+        "recoveries": counts.get("recoveries", 0),
+        "recovered": labels == ["Positive"] and trips == 1
+        and counts.get("recoveries", 0) >= 1,
+        "wall_s": round(elapsed, 4),
+    }
+
+
 @suite("chaos")
 def run() -> dict:
     from music_analyst_tpu.resilience import (
@@ -883,6 +1177,44 @@ def run() -> dict:
             file=sys.stderr,
         )
 
+        ledger_flush = _ledger_flush_scenario(4 if smoke() else 16)
+        print(
+            f"[chaos] ledger_flush: identical="
+            f"{ledger_flush['bytes_identical']} "
+            f"drops={ledger_flush['ledger_drops']} "
+            f"degraded={ledger_flush['degraded_to_drops']}",
+            file=sys.stderr,
+        )
+
+        cache_publish = _cache_publish_scenario()
+        print(
+            f"[chaos] cache_publish: recovered="
+            f"{cache_publish['recovered']}",
+            file=sys.stderr,
+        )
+
+        compile_first = _compile_first_scenario()
+        print(
+            f"[chaos] compile_first: recovered="
+            f"{compile_first['recovered']}",
+            file=sys.stderr,
+        )
+
+        checkpoint_stream = _checkpoint_stream_scenario()
+        print(
+            f"[chaos] checkpoint_stream: identical="
+            f"{checkpoint_stream['bytes_identical']} "
+            f"trips={checkpoint_stream['trips']}",
+            file=sys.stderr,
+        )
+
+        ollama_request = _ollama_request_scenario()
+        print(
+            f"[chaos] ollama_request: recovered="
+            f"{ollama_request['recovered']}",
+            file=sys.stderr,
+        )
+
     reset_retry_stats()
     return {
         "suite": "chaos",
@@ -902,13 +1234,21 @@ def run() -> dict:
         "journal_append": journal_wal,
         "reqtrace_flush": reqtrace_flush,
         "metrics_scrape": metrics_scrape,
+        "ledger_flush": ledger_flush,
+        "cache_publish": cache_publish,
+        "compile_first": compile_first,
+        "checkpoint_stream": checkpoint_stream,
+        "ollama_request": ollama_request,
         "all_identical": all(
             s["bytes_identical"] for s in scenarios
         ) and prefix["bytes_identical"] and spec_draft["bytes_identical"]
         and preempt["bytes_identical"]
         and kv_quant["bytes_identical"]
         and reqtrace_flush["bytes_identical"]
-        and metrics_scrape["bytes_identical"],
+        and metrics_scrape["bytes_identical"]
+        and ledger_flush["bytes_identical"]
+        and compile_first["bytes_identical"]
+        and checkpoint_stream["bytes_identical"],
         "all_recovered": all(
             s["trips"] > 0
             and (s["degraded"] if s["expect_degraded"] else True)
@@ -921,5 +1261,10 @@ def run() -> dict:
         and kv_quant["degraded"]
         and journal_wal["degraded_to_recompute"]
         and reqtrace_flush["degraded_to_drops"]
-        and metrics_scrape["degraded_to_stale"],
+        and metrics_scrape["degraded_to_stale"]
+        and ledger_flush["degraded_to_drops"]
+        and cache_publish["recovered"]
+        and compile_first["recovered"]
+        and checkpoint_stream["recovered"]
+        and ollama_request["recovered"],
     }

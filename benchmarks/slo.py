@@ -390,6 +390,103 @@ def _preempt_scenario() -> dict:
     }
 
 
+def _interference_scenario() -> dict:
+    """Long-prompt flash crowd against a decoding gold tenant: the
+    engine ledger must ATTRIBUTE the interference, not just witness it.
+    The burst window's ``prefill`` fraction has to rise above the
+    baseline window's while the gold tenant's TPOT EWMA degrades in
+    step — reported side by side, so "tokens got slower" always arrives
+    with "because prefill ate the engine"."""
+    from music_analyst_tpu.models.llama import (
+        LlamaConfig,
+        LlamaZeroShotClassifier,
+    )
+    from music_analyst_tpu.serving.decode_loop import ContinuousScheduler
+
+    n_gold, n_bulk = (3, 4) if smoke() else (6, 10)
+    clf = LlamaZeroShotClassifier(
+        config=LlamaConfig.tiny(), max_prompt_len=256
+    )
+    sched = ContinuousScheduler(
+        clf, n_slots=2, prefill_chunk=16, prompt_region=192,
+        max_new_tokens=24, max_queue=64,
+    )
+    sched.warmup()
+    short = "steady gold tenant hook line"
+    long_prompt = (
+        "long prologue verse crowding the prefill engine chunk by chunk "
+        * 2
+    ).strip()
+
+    def _run(tag: str, with_burst: bool) -> None:
+        reqs = [
+            sched.submit(f"gold-{tag}-{i}", short, tenant="gold",
+                         max_new_tokens=24)
+            for i in range(n_gold)
+        ]
+        if with_burst:
+            reqs += [
+                sched.submit(f"bulk-{tag}-{i}", long_prompt, tenant="bulk",
+                             max_new_tokens=4)
+                for i in range(n_bulk)
+            ]
+        sched.run_until_idle()
+        for req in reqs:
+            resp = req.response or {}
+            if not resp.get("ok"):
+                raise RuntimeError(f"{req.id} failed: {resp.get('error')}")
+
+    def _window(fn) -> dict:
+        """Phase-windowed ledger fractions: the ledger is cumulative, so
+        a phase's own attribution is the delta between snapshots."""
+        before = sched.stats()["ledger"]
+        fn()
+        after = sched.stats()["ledger"]
+        wall = after["engine_wall_s"] - before["engine_wall_s"]
+        seconds = {
+            k: after["seconds"][k] - before["seconds"].get(k, 0.0)
+            for k in after["seconds"]
+        }
+        return {
+            "wall_s": round(wall, 6),
+            "fractions": {
+                k: round(v / wall, 6) if wall > 0 else 0.0
+                for k, v in seconds.items()
+            },
+        }
+
+    start = time.perf_counter()
+    base = _window(lambda: _run("base", with_burst=False))
+    gold_tpot_base = (
+        sched.slo_snapshot()["tenants"]["gold"]["tpot_ewma_ms"]
+    )
+    burst = _window(lambda: _run("burst", with_burst=True))
+    elapsed = time.perf_counter() - start
+    snap = sched.slo_snapshot()
+    gold_tpot_burst = snap["tenants"]["gold"]["tpot_ewma_ms"]
+    prefill_base = base["fractions"].get("prefill", 0.0)
+    prefill_burst = burst["fractions"].get("prefill", 0.0)
+    return {
+        "scenario": "prefill_interference",
+        "gold_requests": n_gold * 2,
+        "bulk_requests": n_bulk,
+        "baseline": {**base, "gold_tpot_ewma_ms": gold_tpot_base},
+        "burst": {**burst, "gold_tpot_ewma_ms": gold_tpot_burst},
+        "prefill_frac_delta": round(prefill_burst - prefill_base, 6),
+        "gold_tpot_delta_ms": round(gold_tpot_burst - gold_tpot_base, 6),
+        "chip_seconds": {
+            tenant: info.get("chip_seconds")
+            for tenant, info in snap["tenants"].items()
+        },
+        "ledger_coverage": sched.stats()["ledger"]["coverage"],
+        "interference_attributed": (
+            prefill_burst > prefill_base
+            and gold_tpot_burst > gold_tpot_base
+        ),
+        "wall_s": round(elapsed, 4),
+    }
+
+
 @suite("slo")
 def run() -> dict:
     seed = 42
@@ -418,6 +515,15 @@ def run() -> dict:
     print(f"[slo] preempt_resume: preemptions={preempt['preemptions']} "
           f"identical={preempt['bytes_identical']} "
           f"retraces={preempt['retraces']}", file=sys.stderr)
+    interference = _interference_scenario()
+    print(f"[slo] prefill_interference: "
+          f"prefill {interference['baseline']['fractions'].get('prefill')}"
+          f" -> {interference['burst']['fractions'].get('prefill')}, "
+          f"gold tpot "
+          f"{interference['baseline']['gold_tpot_ewma_ms']}ms -> "
+          f"{interference['burst']['gold_tpot_ewma_ms']}ms, "
+          f"attributed={interference['interference_attributed']}",
+          file=sys.stderr)
     scenarios = [steady, diurnal, flash, faulted, burn]
     return {
         "suite": "slo",
@@ -441,4 +547,6 @@ def run() -> dict:
         "preempt_bytes_identical": preempt["bytes_identical"],
         "zero_retraces": preempt["retraces"] == 0,
         "sheds_carry_trace_ids": faulted["sheds_carry_trace_ids"],
+        "interference": interference,
+        "interference_attributed": interference["interference_attributed"],
     }

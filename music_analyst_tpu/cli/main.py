@@ -448,6 +448,11 @@ def _add_monitor(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--json", action="store_true",
                    help="Emit each snapshot as one JSON object instead "
                         "of the table")
+    p.add_argument("--idle-bubble-gate", type=float, default=None,
+                   metavar="FRAC",
+                   help="With --once: also exit 1 when any engine's "
+                        "ledger idle_bubble fraction exceeds FRAC "
+                        "(0..1) — the goodput health gate")
 
 
 def _add_sweep(sub: argparse._SubParsersAction) -> None:
@@ -517,6 +522,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return run_monitor(
             args.socket, once=args.once, interval_s=args.interval,
             json_output=args.json,
+            idle_bubble_gate=args.idle_bubble_gate,
         )
 
     from music_analyst_tpu.telemetry import configure

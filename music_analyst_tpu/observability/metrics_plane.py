@@ -259,13 +259,32 @@ _SUM_LEAVES = frozenset((
     "plain_ticks", "count",
 ))
 
+# Engine-ledger merge (serving.decode.ledger): attribution seconds and
+# per-tenant chip-seconds are additive chip-time across replicas, so
+# every leaf under these subtrees sums; the scalar ledger counters sum
+# by leaf name.  Fractions/coverage/goodput stay per-replica (they'd be
+# meaningless added) — recompute fleet fractions from the merged
+# seconds against the merged engine_wall_s.
+_LEDGER_SUM_SUBTREES = (
+    ".ledger.seconds.", ".ledger.chip_seconds.", ".ledger.prefill_chunks.",
+)
+_LEDGER_SUM_LEAVES = frozenset((
+    "ticks", "idle_ticks", "engine_wall_s", "tokens_committed",
+    "flushes", "ledger_drops",
+))
+
 
 def _summable(key: str) -> bool:
     leaf = key.rsplit(".", 1)[-1]
     if leaf == "window_s":
         return False
-    if ".rates." in f".{key}.":
+    dotted = f".{key}."
+    if ".rates." in dotted:
         return True  # req_s / tokens_s / shed_s fleet rate = sum
+    if ".ledger." in dotted:
+        if any(sub in dotted for sub in _LEDGER_SUM_SUBTREES):
+            return True
+        return leaf in _LEDGER_SUM_LEAVES
     return leaf in _SUM_LEAVES
 
 

@@ -73,10 +73,50 @@ def extract_row(name: str, stats: Optional[Dict[str, Any]],
     return row
 
 
+def _bar(frac: Any, width: int = 8) -> str:
+    """A fixed-width occupancy bar: ``[####----]``."""
+    if isinstance(frac, bool) or not isinstance(frac, (int, float)):
+        return "[" + "?" * width + "]"
+    filled = int(round(min(1.0, max(0.0, frac)) * width))
+    return "[" + "#" * filled + "-" * (width - filled) + "]"
+
+
+def extract_engine_row(name: str,
+                       stats: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """One engine-panel row from a stats snapshot's ``decode`` section
+    (engine ledger + speculation EWMA); None when the process serves no
+    continuous scheduler."""
+    decode = stats.get("decode") if isinstance(stats, dict) else None
+    if not isinstance(decode, dict):
+        return None
+    ledger = decode.get("ledger") or {}
+    occ = ledger.get("occupancy") or {}
+    fractions = ledger.get("fractions") or {}
+    slots_total = occ.get("slots_total", decode.get("n_slots"))
+    slots_active = occ.get("slots_active", decode.get("active_slots"))
+    occupancy = None
+    if (isinstance(slots_total, int) and slots_total > 0
+            and isinstance(slots_active, int)):
+        occupancy = slots_active / slots_total
+    return {
+        "name": name,
+        "slots_active": slots_active,
+        "slots_total": slots_total,
+        "occupancy": occupancy,
+        "goodput": ledger.get("goodput_fraction"),
+        "prefill": fractions.get("prefill"),
+        "idle_bubble": fractions.get("idle_bubble"),
+        "pages_free": occ.get("pages_free"),
+        "pages_pinned": occ.get("pages_pinned"),
+        "spec_accept": _dig(decode, "speculation", "acceptance_rate"),
+    }
+
+
 def build_view(payload: Dict[str, Any]) -> Dict[str, Any]:
     """The reply payload of one ``stats`` op → rows + alerts + header."""
     stats = payload.get("stats") or {}
     rows: List[Dict[str, Any]] = []
+    engine: List[Dict[str, Any]] = []
     router = stats.get("router")
     if isinstance(router, dict) and router.get("replicas"):
         for name, snap in sorted(router["replicas"].items()):
@@ -84,6 +124,11 @@ def build_view(payload: Dict[str, Any]) -> Dict[str, Any]:
                 name, (snap or {}).get("last_stats"),
                 health=(snap or {}).get("health") or "?",
             ))
+            engine_row = extract_engine_row(
+                name, (snap or {}).get("last_stats")
+            )
+            if engine_row is not None:
+                engine.append(engine_row)
         # The front end's own admission edge rides along as the fleet
         # row: its rates already aggregate what it dispatched.
         fleet = extract_row("fleet", stats)
@@ -94,13 +139,22 @@ def build_view(payload: Dict[str, Any]) -> Dict[str, Any]:
         rows.append(fleet)
     else:
         rows.append(extract_row("local", stats))
+        engine_row = extract_engine_row("local", stats)
+        if engine_row is not None:
+            engine.append(engine_row)
     metrics = stats.get("metrics") or {}
     alerts = list(metrics.get("active_alerts") or [])
+    idle_fracs = [
+        r["idle_bubble"] for r in engine
+        if isinstance(r.get("idle_bubble"), (int, float))
+    ]
     return {
         "mode": stats.get("mode"),
         "uptime_s": stats.get("uptime_s"),
         "draining": bool(stats.get("draining")),
         "rows": rows,
+        "engine": engine,
+        "idle_bubble_max": max(idle_fracs) if idle_fracs else None,
         "alerts": alerts,
         "metrics": {
             k: metrics.get(k)
@@ -130,6 +184,28 @@ def render_view(view: Dict[str, Any]) -> List[str]:
             f"{row['queue_depth'] if row['queue_depth'] is not None else '-':>6} "
             f"{_ms(row['p50_s']):>8} {_ms(row['p99_s']):>8}"
         )
+    engine = view.get("engine") or []
+    if engine:
+        lines.append("engine panel (goodput ledger):")
+        for row in engine:
+            slots = (
+                f"{row['slots_active']}/{row['slots_total']}"
+                if row.get("slots_total") is not None else "-"
+            )
+            pool = (
+                f" pool free={row['pages_free']} pinned={row['pages_pinned']}"
+                if row.get("pages_free") is not None else ""
+            )
+            spec = (
+                f" spec={_num(row['spec_accept'])}"
+                if row.get("spec_accept") is not None else ""
+            )
+            lines.append(
+                f"  {str(row['name'])[:12]:<12} occ {_bar(row['occupancy'])} "
+                f"{slots:>5}  goodput={_num(row['goodput'])} "
+                f"prefill={_num(row['prefill'])} "
+                f"idle={_num(row['idle_bubble'])}{pool}{spec}"
+            )
     metrics = view.get("metrics") or {}
     if metrics:
         shown = " ".join(f"{k}={v}" for k, v in metrics.items())
@@ -191,9 +267,11 @@ class _StatsClient:
 
 def run_monitor(socket_path: str, once: bool = False,
                 interval_s: float = 2.0,
-                json_output: bool = False) -> int:
-    """CLI entry.  0 = healthy reply, 1 = server answered but draining,
-    2 = no usable reply."""
+                json_output: bool = False,
+                idle_bubble_gate: Optional[float] = None) -> int:
+    """CLI entry.  0 = healthy reply, 1 = server answered but draining
+    (or, with ``--idle-bubble-gate``, reported an engine idle_bubble
+    fraction above the threshold), 2 = no usable reply."""
     try:
         client = _StatsClient(socket_path)
     except OSError as exc:
@@ -220,7 +298,18 @@ def run_monitor(socket_path: str, once: bool = False,
                     print(line)
                 sys.stdout.flush()
             if once:
-                return 1 if view["draining"] else 0
+                idle_max = view.get("idle_bubble_max")
+                gate_tripped = (
+                    idle_bubble_gate is not None
+                    and isinstance(idle_max, (int, float))
+                    and idle_max > idle_bubble_gate
+                )
+                if gate_tripped:
+                    print(
+                        f"monitor: idle_bubble {idle_max} exceeds gate "
+                        f"{idle_bubble_gate}", file=sys.stderr,
+                    )
+                return 1 if (view["draining"] or gate_tripped) else 0
             time.sleep(max(interval_s, 0.1))
     except KeyboardInterrupt:
         return 0

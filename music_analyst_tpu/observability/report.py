@@ -254,6 +254,64 @@ def _scan_metrics_jsonl(path: str) -> Dict[str, Any]:
     return {"summary": summary, "alerts": alerts}
 
 
+_LEDGER_FIELDS = (
+    "goodput_fraction", "coverage", "engine_wall_s", "ticks",
+    "tokens_committed", "ledger_drops",
+)
+
+
+def _ledger_summary(ledger: Dict[str, Any],
+                    records: int = 0) -> Optional[Dict[str, Any]]:
+    """Compact digest of one engine-ledger snapshot (engine_ledger.py's
+    ``snapshot()`` shape); None when the engine never ticked."""
+    if not isinstance(ledger, dict) or not ledger.get("ticks"):
+        return None
+    out: Dict[str, Any] = {k: ledger.get(k) for k in _LEDGER_FIELDS}
+    out["records"] = records
+    fractions = ledger.get("fractions")
+    if isinstance(fractions, dict):
+        out["fractions"] = dict(fractions)
+    chip = ledger.get("chip_seconds")
+    if isinstance(chip, dict):
+        out["chip_seconds"] = dict(chip)
+    return out
+
+
+def _scan_ledger_jsonl(path: str) -> Dict[str, Any]:
+    """Single pass over ``engine_ledger.jsonl``.  Each record is a
+    CUMULATIVE snapshot, so the last one IS the run's final ledger;
+    earlier goodput fractions form the within-run trajectory."""
+    final: Optional[Dict[str, Any]] = None
+    records = 0
+    goodput_first: Optional[float] = None
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if not isinstance(rec, dict) or rec.get("type") != "ledger":
+                    continue
+                ledger = rec.get("ledger")
+                if not isinstance(ledger, dict):
+                    continue
+                records += 1
+                final = ledger
+                g = ledger.get("goodput_fraction")
+                if goodput_first is None and isinstance(g, (int, float)):
+                    goodput_first = g
+    except OSError:
+        return {"summary": None}
+    summary = _ledger_summary(final, records) if final else None
+    if summary is not None and goodput_first is not None:
+        summary["goodput_first"] = goodput_first
+    return {"summary": summary}
+
+
 def _dir_record(directory: str, label: str) -> Optional[Dict[str, Any]]:
     """A telemetry run dir: manifest + JSONL + optional flight record."""
     manifest_path = os.path.join(directory, "run_manifest.json")
@@ -334,6 +392,21 @@ def _dir_record(directory: str, label: str) -> Optional[Dict[str, Any]]:
             rec["metrics"] = scan["summary"]
         if scan["alerts"]:
             rec["alerts"] = scan["alerts"]
+    ledger_path = os.path.join(directory, "engine_ledger.jsonl")
+    if os.path.exists(ledger_path):
+        found = True
+        scan = _scan_ledger_jsonl(ledger_path)
+        if scan["summary"]:
+            rec["engine_ledger"] = scan["summary"]
+    if "engine_ledger" not in rec:
+        # No JSONL (flush disarmed) — the manifest's final decode stats
+        # still carry the ledger snapshot.
+        manifest_ledger = (
+            ((rec.get("serving") or {}).get("decode") or {}).get("ledger")
+        )
+        summary = _ledger_summary(manifest_ledger or {})
+        if summary is not None:
+            rec["engine_ledger"] = summary
     if os.path.exists(flight_path):
         found = True
         try:
@@ -400,6 +473,8 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     speculation_runs: List[Dict[str, Any]] = []
     metrics_runs: List[Dict[str, Any]] = []
     alert_history: List[Dict[str, Any]] = []
+    ledger_runs: List[Dict[str, Any]] = []
+    chip_seconds_by_tenant: Dict[str, float] = {}
 
     def _site(site: str) -> Dict[str, int]:
         return resilience_sites.setdefault(
@@ -512,6 +587,17 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             metrics_runs.append({"label": rec["label"], **metrics})
         for alert in rec.get("alerts") or []:
             alert_history.append({"label": rec["label"], **alert})
+        # Engine goodput ledger: per-run attribution digest (scanned from
+        # engine_ledger.jsonl, or the manifest's serving.decode.ledger)
+        # → cross-run goodput trajectory + fleet chip-second totals.
+        ledger = rec.get("engine_ledger")
+        if ledger:
+            ledger_runs.append({"label": rec["label"], **ledger})
+            for tenant, secs in (ledger.get("chip_seconds") or {}).items():
+                if isinstance(secs, (int, float)):
+                    chip_seconds_by_tenant[tenant] = round(
+                        chip_seconds_by_tenant.get(tenant, 0.0) + secs, 6
+                    )
         if spec.get("enabled"):
             speculation_runs.append({
                 "label": rec["label"],
@@ -566,6 +652,11 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "speculation": speculation,
         "metrics_runs": metrics_runs,
         "alert_history": alert_history,
+        "ledger_runs": ledger_runs,
+        "chip_seconds_by_tenant": dict(
+            sorted(chip_seconds_by_tenant.items(),
+                   key=lambda kv: (-kv[1], kv[0]))
+        ),
         "newest": {
             "label": newest["label"],
             "ok": newest["ok"],
@@ -712,6 +803,38 @@ def render_report(report: Dict[str, Any]) -> List[str]:
                 f"burn {alert.get('burn_fast')}x/{alert.get('burn_slow')}x "
                 f"(threshold {alert.get('threshold')}x){trace}"
             )
+    if report.get("ledger_runs"):
+        lines.append("engine ledger (goodput trajectory):")
+
+        def _lnum(value: Any) -> str:
+            return (f"{value:.2f}"
+                    if not isinstance(value, bool)
+                    and isinstance(value, (int, float)) else "-")
+        for run in report["ledger_runs"]:
+            fractions = run.get("fractions") or {}
+            wall = run.get("engine_wall_s")
+            wall_text = (f" wall={wall:.2f}s"
+                         if isinstance(wall, (int, float)) else "")
+            drops = run.get("ledger_drops") or 0
+            drops_text = f" drops={drops}" if drops else ""
+            lines.append(
+                f"  {run['label']}: goodput={_lnum(run.get('goodput_fraction'))} "
+                f"prefill={_lnum(fractions.get('prefill'))} "
+                f"spec_waste={_lnum(fractions.get('spec_waste'))} "
+                f"idle={_lnum(fractions.get('idle_bubble'))} "
+                f"coverage={_lnum(run.get('coverage'))}"
+                f"{wall_text}{drops_text}"
+            )
+        if report.get("chip_seconds_by_tenant"):
+            lines.append("chip-seconds by tenant (all runs):")
+            total = sum(
+                v for v in report["chip_seconds_by_tenant"].values()
+                if isinstance(v, (int, float))
+            )
+            for tenant, secs in report["chip_seconds_by_tenant"].items():
+                share = (f" ({secs / total:.0%})"
+                         if total and isinstance(secs, (int, float)) else "")
+                lines.append(f"  {tenant:<16} {_lnum(secs)}s{share}")
     for run in report.get("degraded_runs") or []:
         lines.append(
             f"  DEGRADED {run['label']}: {run['site']} ({run['reason']})"

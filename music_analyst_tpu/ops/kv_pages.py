@@ -850,3 +850,20 @@ class RadixIndex:
             n += 1
             stack.extend(node.children.values())
         return n
+
+    # Occupancy accessors (engine ledger): tree size without exposing
+    # internals.  Nodes are pages, so node_count == page_count; kept as
+    # a named alias because the ledger reports both dimensions.
+    def node_count(self) -> int:
+        return self.page_count()
+
+    def token_count(self) -> int:
+        """Valid tokens held by the tree — the pinned KV the index keeps
+        resident on behalf of future prefix hits."""
+        n = 0
+        stack = list(self.root.children.values())
+        while stack:
+            node = stack.pop()
+            n += node.n_valid
+            stack.extend(node.children.values())
+        return n

@@ -374,6 +374,18 @@ class SlotDecodeRuntime:
             caches = shard_kv_caches(caches, self.mesh, cfg.n_kv_heads)
         return caches
 
+    def kv_bytes(self, dtype=jnp.bfloat16) -> int:
+        """Resident KV bytes of the monolithic all-slots cache — the
+        engine ledger's occupancy counterpart of the paged runtime's
+        ``pool_bytes`` (keys + values across every layer and slot)."""
+        cfg = self.config
+        head_dim = cfg.dim // cfg.n_heads
+        itemsize = jnp.zeros((), dtype).dtype.itemsize
+        return (
+            cfg.n_layers * 2 * self.plan.n_slots * self.plan.max_total
+            * cfg.n_kv_heads * head_dim * itemsize
+        )
+
     def compiled_variants(self) -> int:
         """Total compiled-program count across the six programs — the
         zero-retrace assertion reads this before/after a workload."""

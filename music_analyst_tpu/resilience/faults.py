@@ -85,6 +85,10 @@ SITES = frozenset(
         # construction time — replies stay byte-identical, the stats
         # block flags ``kv_quant.degraded``.
         "kv_quant.dequant",
+        # Engine-ledger flush (observability/engine_ledger.py): a failing
+        # JSONL append degrades to a counted ``ledger_drops`` — replies
+        # stay byte-identical and the file is never torn.
+        "ledger.flush",
     }
 )
 

@@ -103,6 +103,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from music_analyst_tpu.observability import watchdog
+from music_analyst_tpu.observability.engine_ledger import EngineLedger
 from music_analyst_tpu.ops.kv_pages import PagePool, RadixIndex
 from music_analyst_tpu.resilience.faults import fault_point, InjectedFault
 from music_analyst_tpu.resilience.policy import RetryPolicy
@@ -292,6 +293,8 @@ class ContinuousScheduler:
         priority: Optional[int] = None,
         checkpoint_interval: Optional[int] = None,
         speculate_k: Optional[int] = None,
+        ledger_interval_ms: Optional[Any] = None,
+        ledger_dir: Optional[str] = None,
     ) -> None:
         self.backend = backend
         self.n_slots = resolve_slots(n_slots)
@@ -440,6 +443,30 @@ class ContinuousScheduler:
         self._tpot_ewma_s = 0.0
         self._t_started = time.monotonic()
         self._warmup_record: Optional[Dict[str, Any]] = None
+        # Engine goodput ledger (observability/engine_ledger.py): per-tick
+        # wall-time attribution + occupancy + per-tenant chip-seconds.
+        # Recording is always on (host-side float adds — no device work,
+        # no readbacks, no per-tick allocation); file flushing rides the
+        # metrics cadence and only arms when a profile dir is resolved.
+        self._ledger = EngineLedger(
+            self.plan.n_slots,
+            interval_ms=ledger_interval_ms,
+            directory=ledger_dir,
+        )
+        self._ledger.attach_occupancy(self._ledger_occupancy_sample)
+        # Per-tick attribution scratch — reset at tick start, consumed by
+        # record_tick; plain float/int adds on the hot path.
+        self._led_prefill_s = 0.0
+        self._led_chunks_cold = 0
+        self._led_chunks_shared = 0
+        self._led_decode_s = 0.0
+        self._led_useful_frac = 1.0
+        self._led_committed = 0
+        self._led_preempt_s = 0.0
+        # Tenant slot shares captured right after admission — settle frees
+        # slots mid-tick, so reading occupancy at record time would drop
+        # the attribution for requests that finish within their tick.
+        self._led_shares: Dict[str, int] = {}
 
     # ----------------------------------------------------------- lifecycle
 
@@ -464,6 +491,7 @@ class ContinuousScheduler:
         if thread is None:
             # Synchronous use: drain means "finish the backlog inline".
             self.run_until_idle()
+        self._ledger.close()
 
     @property
     def draining(self) -> bool:
@@ -732,6 +760,7 @@ class ContinuousScheduler:
         if ledger is None:
             ledger = self._tenants[tenant] = {
                 "admitted": 0, "completed": 0, "shed": 0,
+                "tpot_ewma_ms": 0.0,
             }
         return ledger
 
@@ -837,7 +866,9 @@ class ContinuousScheduler:
             with self._cond:
                 if self._draining and not self._queue and not self._occupied():
                     return
+                t_wait = time.perf_counter()
                 self._cond.wait(0.005)
+                self._ledger.idle_wait(t_wait, time.perf_counter())
 
     def run_until_idle(self, max_ticks: int = 1_000_000) -> None:
         """Synchronous driver: tick until queue and slots are empty."""
@@ -856,10 +887,36 @@ class ContinuousScheduler:
         advance one prefill chunk per mid-prefill slot, run one decode
         dispatch over all slots, settle completions.  Returns whether any
         work happened."""
+        t0 = time.perf_counter()
+        self._led_prefill_s = 0.0
+        self._led_chunks_cold = 0
+        self._led_chunks_shared = 0
+        self._led_decode_s = 0.0
+        self._led_useful_frac = 1.0
+        self._led_committed = 0
+        self._led_preempt_s = 0.0
         did = self._admit()
+        shares = self._led_shares
+        shares.clear()
+        for s in self._slots:
+            if s is not None:
+                tenant = s.req.tenant
+                shares[tenant] = shares.get(tenant, 0) + 1
         did = self._prefill_tick() or did
         did = self._decode_tick() or did
         self._publish_gauges()
+        self._ledger.record_tick(
+            t0, time.perf_counter(),
+            prefill_s=self._led_prefill_s,
+            chunks_cold=self._led_chunks_cold,
+            chunks_shared=self._led_chunks_shared,
+            decode_s=self._led_decode_s,
+            useful_frac=self._led_useful_frac,
+            committed=self._led_committed,
+            preempt_s=self._led_preempt_s,
+            shares=shares,
+        )
+        self._ledger.maybe_flush()
         return did
 
     # ------------------------------------------------------------ admit
@@ -1004,6 +1061,11 @@ class ContinuousScheduler:
             self._bump(preempt_faults=1)
             get_telemetry().count("serving.preempt_faults")
             return None
+        # Ledger: the whole steal window counts once as preempt_overhead
+        # (the embedded _checkpoint times itself — rebase on the snapshot
+        # so it isn't double-counted).
+        pre_t0 = time.perf_counter()
+        led_before = self._led_preempt_s
         if self.paged and self._radix is not None:
             self._adopt(victim)  # no-op when prefill already adopted them
         # Checkpoint BEFORE the slot is released: the victim re-enters
@@ -1032,6 +1094,7 @@ class ContinuousScheduler:
         self._free([idx])
         self._bump(preemptions=1)
         get_telemetry().count("serving.preemptions")
+        self._led_preempt_s = led_before + (time.perf_counter() - pre_t0)
         return idx
 
     def _tpot_throttled(self, head: ServeRequest) -> bool:
@@ -1193,6 +1256,7 @@ class ContinuousScheduler:
         """
         import jax.numpy as jnp
 
+        pre_t0 = time.perf_counter()
         key = _ckpt_key(slot.req.id)
         old = self._ckpts.pop(key, None)
         if old is not None:
@@ -1211,6 +1275,7 @@ class ContinuousScheduler:
             self._release_ckpt(evicted)
         self._bump(checkpoints_taken=1)
         get_telemetry().count("serving.checkpoints_taken")
+        self._led_preempt_s += time.perf_counter() - pre_t0
 
     def _release_ckpt(self, ck: _Checkpoint) -> None:
         """Drop a checkpoint's KV hold (unpin the row / free the copy)."""
@@ -1241,6 +1306,7 @@ class ContinuousScheduler:
         """
         import jax.numpy as jnp
 
+        pre_t0 = time.perf_counter()
         slot = _Slot(req, ck.ids, ck.plen, ck.budget)
         slot.tokens = list(ck.tokens)
         slot.steps = ck.steps
@@ -1269,6 +1335,7 @@ class ContinuousScheduler:
         self._slots[idx] = slot
         self._bump(resumed_o1=1, resume_chunks_skipped=chunks)
         get_telemetry().count("serving.resumed_o1")
+        self._led_preempt_s += time.perf_counter() - pre_t0
 
     # ------------------------------------------------------------ prefill
 
@@ -1322,12 +1389,14 @@ class ContinuousScheduler:
                 continue
             did = True
             rt_t0 = time.time() if rt.enabled else None
+            pf_t0 = time.perf_counter()
             try:
                 with watchdog.watch("decode.dispatch", kind="decode"):
                     caches, first, is_last = self._retry.call(
                         self._device_prefill, idx, slot, site="decode.step"
                     )
             except Exception as exc:  # noqa: BLE001 — poison isolation
+                self._led_prefill_s += time.perf_counter() - pf_t0
                 # The poison prompt fails ALONE: its slot is freed (and
                 # zeroed) while co-resident slots keep decoding.
                 slot.req.fail("request_failed",
@@ -1337,6 +1406,11 @@ class ContinuousScheduler:
                 self._fanout(slot.req)
                 self._free([idx], zero=True)
                 continue
+            self._led_prefill_s += time.perf_counter() - pf_t0
+            if slot.kv_shared or slot.skipped:
+                self._led_chunks_shared += 1
+            else:
+                self._led_chunks_cold += 1
             self.caches = caches
             self._bump(prefill_dispatches=1)
             if rt.enabled:
@@ -1352,7 +1426,9 @@ class ContinuousScheduler:
             else:
                 slot.next_chunk += self.plan.prefill_chunk
         if finishing:
+            pf_t0 = time.perf_counter()
             firsts = jax.device_get([f for _, _, f in finishing])
+            self._led_prefill_s += time.perf_counter() - pf_t0
             for (idx, slot, _), first in zip(finishing, firsts):
                 slot.next_chunk = -1
                 if self.paged and self._radix is not None:
@@ -1601,6 +1677,14 @@ class ContinuousScheduler:
             for rate in rates:
                 self._accept_hist.observe(rate)
             self._block_hist.observe(committed / len(occupied))
+        # Ledger attribution: the verify dispatch's useful slice is the
+        # committed-token fraction of the [n_occupied, k+1] block; the
+        # rest of the measured device time is drafted-but-rejected work.
+        self._led_decode_s += decode_s
+        self._led_committed += committed
+        self._led_useful_frac = committed / max(
+            1, len(occupied) * tokens_blk.shape[1]
+        )
         self._rates["tokens_s"].mark(committed)
         tel.observe("serving.slot_occupancy", occ,
                     buckets=_OCCUPANCY_BUCKETS)
@@ -1681,6 +1765,8 @@ class ContinuousScheduler:
             saw_eos = emitted_n > 0 and self.runtime.eos_id in s.tokens[-emitted_n:]
             if saw_eos or s.steps >= s.budget:
                 freed.append(i)
+        self._led_decode_s += decode_s
+        self._led_committed += emitted_total
         self._rates["tokens_s"].mark(emitted_total)
         # Periodic checkpoint tick: refresh still-running slots so a
         # later failure loses at most ``checkpoint_interval`` dispatches
@@ -1720,6 +1806,13 @@ class ContinuousScheduler:
                 self._tpot_ewma_s = (
                     tpot if self._tpot_ewma_s == 0.0
                     else 0.8 * self._tpot_ewma_s + 0.2 * tpot
+                )
+                led = self._tenant_ledger(slot.req.tenant)
+                prev_ms = led.get("tpot_ewma_ms", 0.0)
+                tpot_ms = tpot * 1000.0
+                led["tpot_ewma_ms"] = round(
+                    tpot_ms if prev_ms == 0.0
+                    else 0.8 * prev_ms + 0.2 * tpot_ms, 6
                 )
                 if tpot_miss:
                     self._stats["tpot_slo_misses"] += 1
@@ -1948,7 +2041,66 @@ class ContinuousScheduler:
                     "compression": round(unq_ratio, 4),
                 },
             )
+        # Engine goodput ledger: per-tick wall-time attribution +
+        # occupancy + per-tenant chip-seconds (manifest
+        # ``serving.decode.ledger``; flattened counters merge fleet-wide
+        # through the metrics plane's stats-poll ingest).
+        out["ledger"] = self._ledger.snapshot()
         return out
+
+    def _ledger_occupancy_sample(self) -> Dict[str, Any]:
+        """Occupancy snapshot for the ledger: read off the structures
+        that already know the truth (slots, page pool, radix tree, KV
+        byte accounting).  Called at flush/stats time only — never on
+        the per-tick hot path."""
+        active = self._occupied()
+        occ: Dict[str, Any] = {
+            "slots_active": active,
+            "slots_total": self.plan.n_slots,
+            "slot_occupancy": round(active / self.plan.n_slots, 6),
+        }
+        if self.paged and self._pool is not None:
+            pool = self._pool
+            pinned = sum(1 for r in pool.slot_refs if r > 0)
+            shared = sum(1 for r in pool.slot_refs if r > 1)
+            in_tree = sum(1 for t in pool.in_tree if t)
+            # Boundary-page fragmentation: tokens reserved but unfilled
+            # in each occupied slot's last mapped page.
+            P = self.plan.page_size
+            frag = 0
+            for s in self._slots:
+                if s is None or not s.pages:
+                    continue
+                used = min(s.plen + s.steps, len(s.pages) * P)
+                frag += len(s.pages) * P - used
+            occ.update(
+                pages_total=pool.n_pages,
+                pages_free=pool.free_count,
+                pages_pinned=pinned,
+                pages_shared=shared,
+                pages_in_tree=in_tree,
+                boundary_fragmentation_tokens=frag,
+            )
+            if self._radix is not None:
+                occ.update(
+                    radix_nodes=self._radix.node_count(),
+                    radix_pinned_tokens=self._radix.token_count(),
+                )
+            occ.update(
+                kv_pool_bytes=self.runtime.pool_bytes(),
+                kv_pool_bytes_unquantized=round(
+                    self.runtime.pool_bytes()
+                    * self.runtime.kv_token_bytes_unquantized()
+                    / self.runtime.kv_token_bytes()
+                ),
+            )
+        else:
+            kv_bytes = self.runtime.kv_bytes()
+            occ.update(
+                kv_pool_bytes=kv_bytes,
+                kv_pool_bytes_unquantized=kv_bytes,
+            )
+        return occ
 
     def slo_snapshot(self) -> Dict[str, Any]:
         """The manifest's ``serving.slo.decode`` contribution: targets,
@@ -1968,6 +2120,12 @@ class ContinuousScheduler:
                             "tpot_throttle_ticks", "ttft_slo_misses",
                             "tpot_slo_misses")
             }
+        # Chip-second attribution (engine ledger): what each tenant's
+        # slot share actually cost in engine time — the number the
+        # admission ledgers alone can't provide.
+        chip = self._ledger.chip_seconds()
+        for t, v in tenants.items():
+            v["chip_seconds"] = round(chip.get(t, 0.0), 6)
         configured = (
             self.ttft_slo_ms > 0.0 or self.tpot_slo_ms > 0.0
             or self.tenant_budget > 0.0

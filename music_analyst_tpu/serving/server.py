@@ -763,6 +763,9 @@ def run_server(
                 tpot_slo_ms=tpot_slo_ms,
                 tenant_budget=tenant_budget,
                 priority=priority,
+                # Engine ledger: flushes to the same profile dir on the
+                # metrics cadence ($MUSICAAL_LEDGER_* override either).
+                ledger_dir=trace_dir,
             )
             if warmup:
                 record = residency.warmup_decode(decode)

@@ -1,0 +1,491 @@
+"""Engine goodput ledger (ISSUE 19): exact attribution tiling.
+
+Contract families:
+
+* **tiling** — every accounted second lands in exactly one attribution
+  class and the classes sum to the engine-wall span (coverage == 1.0 by
+  construction); per-tenant chip-seconds tile the same span, with empty
+  engine time on the reserved ``(idle)`` tenant.
+* **knobs** — ``resolve_ledger_interval_ms`` / ``resolve_ledger_dir``
+  follow the house resolve_* ladder: explicit flag raises on malformed,
+  env falls back, the metrics-plane cadence is the default.
+* **flushing** — cumulative O_APPEND JSONL records, never torn; the
+  ``ledger.flush`` fault site degrades to counted ``ledger_drops`` and
+  the file stays intact.
+* **scheduler integration** — a real continuous-scheduler workload
+  tiles ≥95%, chip-seconds within 2% of engine wall, self-measured
+  overhead ≤1%, byte-identical replies and zero retraces with flushing
+  on vs off.
+* **fleet merge** — ledger counters flatten and sum across replicas
+  exactly (mirroring tests/test_metrics_plane.py's merge oracle);
+  fractions, ratios and config never sum; stale replicas are excluded.
+* **surfaces** — monitor engine panel rows + the ``--idle-bubble-gate``
+  exit code; telemetry-report's goodput trajectory and per-tenant
+  chip-seconds table.
+"""
+
+import json
+import os
+import socket
+import threading
+
+import pytest
+
+from music_analyst_tpu.observability.engine_ledger import (
+    IDLE_TENANT,
+    LEDGER_FILE,
+    EngineLedger,
+    resolve_ledger_dir,
+    resolve_ledger_interval_ms,
+)
+
+
+class _Req:
+    def __init__(self, tenant):
+        self.tenant = tenant
+
+
+class _Slot:
+    def __init__(self, tenant):
+        self.req = _Req(tenant)
+
+
+# --------------------------------------------------------------- tiling
+
+
+def test_tick_attribution_tiles_exactly():
+    led = EngineLedger(4, interval_ms=0)
+    t = 100.0
+    led.record_tick(t, t + 1.0, prefill_s=0.25, chunks_cold=1,
+                    decode_s=0.5, useful_frac=0.8, committed=4,
+                    slots=[_Slot("gold"), _Slot("bulk"), None, None])
+    led.idle_wait(t + 1.0, t + 1.5)
+    led.record_tick(t + 2.0, t + 2.5, decode_s=0.4, committed=1,
+                    slots=[_Slot("gold"), None, None, None])
+    snap = led.snapshot()
+    assert snap["engine_wall_s"] == pytest.approx(2.5)
+    assert snap["coverage"] == pytest.approx(1.0)
+    s = snap["seconds"]
+    assert s["decode_useful"] == pytest.approx(0.5 * 0.8 + 0.4)
+    assert s["spec_waste"] == pytest.approx(0.5 * 0.2)
+    assert s["prefill"] == pytest.approx(0.25)
+    # tick-1 residual 0.25 + inter-tick gap 0.5 + tick-2 residual 0.1
+    assert s["host_gap"] == pytest.approx(0.85)
+    assert s["idle_bubble"] == pytest.approx(0.5)  # the timed loop wait
+    assert sum(s.values()) == pytest.approx(snap["engine_wall_s"])
+    assert snap["goodput_fraction"] == pytest.approx(0.8 / 2.5)
+    chip = snap["chip_seconds"]
+    assert chip["gold"] == pytest.approx(0.5 + 1.0)  # half of t1 + all t2
+    assert chip["bulk"] == pytest.approx(0.5)
+    assert chip[IDLE_TENANT] == pytest.approx(0.5)
+    assert sum(chip.values()) == pytest.approx(snap["engine_wall_s"])
+    assert snap["tokens_committed"] == 5
+    assert snap["prefill_chunks"] == {"cold": 1, "shared_hit": 0}
+
+
+def test_empty_tick_is_idle_bubble():
+    led = EngineLedger(2, interval_ms=0)
+    led.record_tick(0.0, 0.5, slots=[None, None])
+    snap = led.snapshot()
+    assert snap["ticks"] == 1 and snap["idle_ticks"] == 1
+    assert snap["seconds"]["idle_bubble"] == pytest.approx(0.5)
+    assert snap["chip_seconds"] == {IDLE_TENANT: 0.5}
+    assert snap["goodput_fraction"] == 0.0
+
+
+def test_empty_ledger_snapshot_is_zeroed():
+    snap = EngineLedger(2, interval_ms=0).snapshot()
+    assert snap["ticks"] == 0
+    assert snap["engine_wall_s"] == 0.0
+    assert snap["coverage"] == 0.0
+    assert snap["chip_seconds"] == {}
+
+
+# ---------------------------------------------------------------- knobs
+
+
+def test_resolve_interval_explicit_flag_raises_on_malformed():
+    with pytest.raises(ValueError, match="ledger-interval-ms"):
+        resolve_ledger_interval_ms("fast")
+    with pytest.raises(ValueError, match="ledger-interval-ms"):
+        resolve_ledger_interval_ms(-5)
+
+
+def test_resolve_interval_env_ladder(monkeypatch):
+    monkeypatch.delenv("MUSICAAL_LEDGER_INTERVAL_MS", raising=False)
+    monkeypatch.delenv("MUSICAAL_METRICS_INTERVAL_MS", raising=False)
+    assert resolve_ledger_interval_ms(None) == 0.0  # default: no flush
+    monkeypatch.setenv("MUSICAAL_METRICS_INTERVAL_MS", "250")
+    assert resolve_ledger_interval_ms(None) == 250.0  # metrics cadence
+    monkeypatch.setenv("MUSICAAL_LEDGER_INTERVAL_MS", "125")
+    assert resolve_ledger_interval_ms(None) == 125.0  # own env wins
+    monkeypatch.setenv("MUSICAAL_LEDGER_INTERVAL_MS", "junk")
+    assert resolve_ledger_interval_ms(None) == 250.0  # malformed env falls
+    assert resolve_ledger_interval_ms(40) == 40.0  # explicit beats all
+
+
+def test_resolve_dir_precedence(monkeypatch, tmp_path):
+    monkeypatch.setenv("MUSICAAL_LEDGER_DIR", str(tmp_path / "env"))
+    assert resolve_ledger_dir(str(tmp_path / "flag")) == str(
+        tmp_path / "flag"
+    )
+    assert resolve_ledger_dir(None) == str(tmp_path / "env")
+
+
+def test_file_disarmed_without_dir_or_interval(monkeypatch, tmp_path):
+    for var in ("MUSICAAL_LEDGER_DIR", "MUSICAAL_METRICS_DIR",
+                "MUSICAAL_LEDGER_INTERVAL_MS",
+                "MUSICAAL_METRICS_INTERVAL_MS"):
+        monkeypatch.delenv(var, raising=False)
+    assert EngineLedger(1, interval_ms=0, directory=str(tmp_path)).path \
+        is None
+    assert EngineLedger(1, interval_ms=50, directory=None).path is None
+    armed = EngineLedger(1, interval_ms=50, directory=str(tmp_path))
+    assert armed.path == str(tmp_path / LEDGER_FILE)
+
+
+# ------------------------------------------------------------- flushing
+
+
+def test_flush_writes_cumulative_intact_jsonl(tmp_path):
+    led = EngineLedger(2, interval_ms=10, directory=str(tmp_path))
+    led.record_tick(0.0, 0.1, decode_s=0.05, committed=1,
+                    slots=[_Slot("gold"), None])
+    assert led.maybe_flush(force=True) is True
+    led.record_tick(0.2, 0.3, decode_s=0.05, committed=1,
+                    slots=[_Slot("gold"), None])
+    led.close()  # drain: one final forced flush
+    lines = (tmp_path / LEDGER_FILE).read_text().splitlines()
+    assert len(lines) == led.flushes == 2
+    recs = [json.loads(line) for line in lines]
+    assert all(r["type"] == "ledger" for r in recs)
+    assert recs[0]["ledger"]["ticks"] == 1
+    assert recs[-1]["ledger"]["ticks"] == 2  # cumulative, last is final
+    assert recs[-1]["pid"] == os.getpid()
+
+
+def test_fault_site_ledger_flush_degrades_to_counted_drops(tmp_path):
+    from music_analyst_tpu.resilience import configure_faults
+
+    led = EngineLedger(2, interval_ms=10, directory=str(tmp_path))
+    led.record_tick(0.0, 0.1, decode_s=0.05, slots=[_Slot("gold"), None])
+    configure_faults("ledger.flush:error@1+")
+    try:
+        assert led.maybe_flush(force=True) is False
+        assert led.maybe_flush(force=True) is False
+    finally:
+        configure_faults(None)
+    assert led.ledger_drops == 2 and led.flushes == 0
+    # a failed flush writes NOTHING — no torn line ever lands
+    assert not (tmp_path / LEDGER_FILE).exists()
+    # recovery: the next flush lands the full cumulative state,
+    # drops included — nothing was lost, only the flush cadence
+    assert led.maybe_flush(force=True) is True
+    rec = json.loads((tmp_path / LEDGER_FILE).read_text())
+    assert rec["ledger"]["ledger_drops"] == 2
+    assert rec["ledger"]["ticks"] == 1
+
+
+# ------------------------------------------------- scheduler integration
+
+
+@pytest.fixture(scope="module")
+def clf():
+    from music_analyst_tpu.models.llama import (
+        LlamaConfig,
+        LlamaZeroShotClassifier,
+    )
+
+    return LlamaZeroShotClassifier(
+        config=LlamaConfig.tiny(), max_prompt_len=64
+    )
+
+
+PROMPTS = [
+    "golden sunshine on the river",
+    "rain",
+    "shadows fall across the empty street",
+    "my heart beats a broken drum",
+    "la la la la",
+    "winter wind and summer fire",
+]
+
+
+def _texts(sched, tag):
+    reqs = [
+        sched.submit(f"{tag}-{i}", p, max_new_tokens=6,
+                     tenant=("gold" if i % 2 == 0 else "bulk"))
+        for i, p in enumerate(PROMPTS)
+    ]
+    sched.run_until_idle()
+    out = []
+    for req in reqs:
+        resp = req.response or {}
+        assert resp.get("ok"), resp
+        out.append(resp["text"])
+    return out
+
+
+def test_scheduler_ledger_tiles_and_attributes(clf):
+    from music_analyst_tpu.serving.decode_loop import ContinuousScheduler
+
+    sched = ContinuousScheduler(
+        clf, n_slots=2, prefill_chunk=16, prompt_region=64,
+        max_new_tokens=8, max_queue=16, ledger_interval_ms=0,
+    )
+    sched.warmup()
+    _texts(sched, "tile")
+    snap = sched.stats()["ledger"]
+    wall = snap["engine_wall_s"]
+    assert snap["ticks"] > 0 and wall > 0
+    # ISSUE bars: ≥95% coverage, chip-seconds within 2% of engine wall,
+    # self-measured recording overhead ≤1%.
+    assert snap["coverage"] >= 0.95
+    assert sum(snap["seconds"].values()) == pytest.approx(wall, rel=0.05)
+    chip = snap["chip_seconds"]
+    assert sum(chip.values()) == pytest.approx(wall, rel=0.02)
+    assert chip.get("gold", 0.0) > 0.0 and chip.get("bulk", 0.0) > 0.0
+    assert snap["overhead_fraction"] <= 0.01
+    assert snap["tokens_committed"] > 0
+    assert snap["goodput_fraction"] > 0.0
+    assert snap["prefill_chunks"]["cold"] >= 1
+    occ = snap["occupancy"]
+    assert occ["slots_total"] == 2
+    assert "pages_free" in occ and "radix_nodes" in occ
+    assert occ["kv_pool_bytes"] > 0
+    # SLO surface: per-tenant TPOT EWMA + chip-second attribution
+    tenants = sched.slo_snapshot()["tenants"]
+    assert tenants["gold"]["tpot_ewma_ms"] > 0.0
+    assert tenants["gold"]["chip_seconds"] == pytest.approx(
+        chip["gold"], abs=1e-5
+    )
+
+
+def test_ledger_flush_keeps_bytes_identical_and_zero_retraces(
+    clf, tmp_path
+):
+    from music_analyst_tpu.serving.decode_loop import ContinuousScheduler
+
+    kw = dict(n_slots=2, prefill_chunk=16, prompt_region=64,
+              max_new_tokens=8, max_queue=16)
+    base = ContinuousScheduler(clf, ledger_interval_ms=0, **kw)
+    base.warmup()
+    want = _texts(base, "base")
+
+    sched = ContinuousScheduler(
+        clf, ledger_interval_ms=5, ledger_dir=str(tmp_path), **kw
+    )
+    sched.warmup()
+    variants0 = sched.runtime.compiled_variants()
+    assert _texts(sched, "flush") == want  # greedy bytes identical
+    assert sched.runtime.compiled_variants() - variants0 == 0
+    sched.drain()  # final forced flush
+    lines = (tmp_path / LEDGER_FILE).read_text().splitlines()
+    assert lines
+    final = json.loads(lines[-1])["ledger"]
+    assert final["coverage"] >= 0.95
+    assert final["flushes"] >= 1 and final["ledger_drops"] == 0
+
+
+# ---------------------------------------------------------- fleet merge
+
+
+def _replica_ledger(scale_s: float) -> dict:
+    led = EngineLedger(2, interval_ms=0)
+    led.record_tick(0.0, scale_s, decode_s=scale_s * 0.5, committed=3,
+                    slots=[_Slot("gold"), None])
+    led.record_tick(scale_s, 2 * scale_s, slots=[None, None])
+    return led.snapshot()
+
+
+def test_fleet_merge_sums_ledger_counters_exactly():
+    """Router-merged ledger == counter-wise sum of per-replica ledgers —
+    the same exactness oracle test_metrics_plane.py holds merge_flat to."""
+    from music_analyst_tpu.observability.metrics_plane import (
+        flatten_stats,
+        merge_flat,
+    )
+
+    flats = [
+        flatten_stats({"decode": {"ledger": _replica_ledger(1.0)}})[0],
+        flatten_stats({"decode": {"ledger": _replica_ledger(0.5)}})[0],
+    ]
+    fleet = merge_flat(flats)
+    assert fleet["decode.ledger.seconds.decode_useful"] == pytest.approx(
+        0.5 + 0.25
+    )
+    assert fleet["decode.ledger.seconds.idle_bubble"] == pytest.approx(1.5)
+    assert fleet["decode.ledger.chip_seconds.gold"] == pytest.approx(1.5)
+    assert fleet[f"decode.ledger.chip_seconds.{IDLE_TENANT}"] == (
+        pytest.approx(1.5)
+    )
+    assert fleet["decode.ledger.engine_wall_s"] == pytest.approx(3.0)
+    assert fleet["decode.ledger.ticks"] == 4.0
+    assert fleet["decode.ledger.idle_ticks"] == 2.0
+    assert fleet["decode.ledger.tokens_committed"] == 6.0
+    # fleet fractions recompute from merged seconds / merged wall;
+    # per-replica ratios and config must never sum
+    for key in ("decode.ledger.goodput_fraction", "decode.ledger.coverage",
+                "decode.ledger.fractions.prefill",
+                "decode.ledger.fractions.idle_bubble",
+                "decode.ledger.overhead_fraction",
+                "decode.ledger.interval_ms"):
+        assert key not in fleet, key
+
+
+def test_stale_replica_excluded_from_ledger_merge():
+    from music_analyst_tpu.observability.metrics_plane import MetricsPlane
+
+    plane = MetricsPlane(50.0)
+    plane.ingest_replica(
+        "r0", {"decode": {"ledger": _replica_ledger(1.0)}}
+    )
+    plane.ingest_replica(
+        "r1", {"decode": {"ledger": _replica_ledger(0.5)}}
+    )
+    plane.mark_replica_stale("r1")
+    merged = plane.fleet_snapshot()["merged"]
+    assert merged["decode.ledger.engine_wall_s"] == pytest.approx(2.0)
+    assert merged["decode.ledger.seconds.decode_useful"] == (
+        pytest.approx(0.5)
+    )
+
+
+# -------------------------------------------------------------- monitor
+
+
+def _monitor_stats(idle_frac: float = 0.5) -> dict:
+    ledger = _replica_ledger(1.0)
+    ledger["occupancy"] = {
+        "slots_total": 2, "slots_active": 1,
+        "pages_free": 12, "pages_pinned": 3,
+    }
+    ledger["fractions"]["idle_bubble"] = idle_frac
+    return {
+        "mode": "server", "uptime_s": 1.0, "draining": False,
+        "requests": {},
+        "decode": {
+            "ledger": ledger,
+            "speculation": {"acceptance_rate": 0.75},
+        },
+    }
+
+
+def test_monitor_engine_panel_rows_and_render():
+    from music_analyst_tpu.observability.monitor import (
+        build_view,
+        extract_engine_row,
+        render_view,
+    )
+
+    stats = _monitor_stats()
+    row = extract_engine_row("local", stats)
+    assert row["occupancy"] == 0.5
+    assert row["pages_free"] == 12 and row["pages_pinned"] == 3
+    assert row["spec_accept"] == 0.75
+    assert row["goodput"] == stats["decode"]["ledger"]["goodput_fraction"]
+    view = build_view({"stats": stats})
+    assert view["engine"] and view["idle_bubble_max"] == 0.5
+    text = "\n".join(render_view(view))
+    assert "engine panel (goodput ledger):" in text
+    assert "pool free=12 pinned=3" in text
+    assert "spec=0.75" in text
+
+
+def test_monitor_engine_row_absent_without_scheduler():
+    from music_analyst_tpu.observability.monitor import (
+        build_view,
+        extract_engine_row,
+    )
+
+    assert extract_engine_row("local", {"requests": {}}) is None
+    view = build_view({"stats": {"requests": {}}})
+    assert view["engine"] == [] and view["idle_bubble_max"] is None
+
+
+def _stub_stats_server(sock_path: str, stats: dict) -> threading.Thread:
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(sock_path)
+    srv.listen(1)
+
+    def _serve():
+        conn, _ = srv.accept()
+        rfile = conn.makefile("r", encoding="utf-8")
+        req = json.loads(rfile.readline())
+        reply = {"id": req["id"], "ok": True, "stats": stats}
+        conn.sendall((json.dumps(reply) + "\n").encode("utf-8"))
+        conn.close()
+        srv.close()
+
+    thread = threading.Thread(target=_serve, daemon=True)
+    thread.start()
+    return thread
+
+
+def test_monitor_once_idle_bubble_gate_exit_codes(tmp_path, capsys):
+    from music_analyst_tpu.observability.monitor import run_monitor
+
+    sock = str(tmp_path / "gate.sock")
+    _stub_stats_server(sock, _monitor_stats(idle_frac=0.6))
+    assert run_monitor(sock, once=True, idle_bubble_gate=0.5) == 1
+    assert "exceeds gate" in capsys.readouterr().err
+
+    sock2 = str(tmp_path / "ok.sock")
+    _stub_stats_server(sock2, _monitor_stats(idle_frac=0.2))
+    assert run_monitor(sock2, once=True, idle_bubble_gate=0.5) == 0
+
+
+# --------------------------------------------------------------- report
+
+
+def test_telemetry_report_ledger_trajectory_and_chip_table(tmp_path):
+    from music_analyst_tpu.observability.report import (
+        build_report,
+        load_run,
+        render_report,
+    )
+
+    d = tmp_path / "run"
+    d.mkdir()
+    early = _replica_ledger(0.5)
+    final = _replica_ledger(1.0)
+    with open(d / "engine_ledger.jsonl", "w", encoding="utf-8") as fh:
+        fh.write(json.dumps({"type": "ledger", "t": 1.0,
+                             "ledger": early}) + "\n")
+        fh.write(json.dumps({"type": "ledger", "t": 2.0,
+                             "ledger": final}) + "\n")
+    rec = load_run(str(d))
+    assert rec is not None
+    summary = rec["engine_ledger"]
+    assert summary["records"] == 2
+    # records are cumulative — the LAST one is the run's final ledger
+    assert summary["goodput_fraction"] == final["goodput_fraction"]
+    assert summary["chip_seconds"] == final["chip_seconds"]
+    report = build_report([rec])
+    assert report["ledger_runs"][0]["goodput_fraction"] == (
+        final["goodput_fraction"]
+    )
+    assert report["chip_seconds_by_tenant"]["gold"] == pytest.approx(
+        final["chip_seconds"]["gold"]
+    )
+    text = "\n".join(render_report(report))
+    assert "engine ledger (goodput trajectory):" in text
+    assert "chip-seconds by tenant (all runs):" in text
+    assert "gold" in text
+
+
+def test_telemetry_report_ledger_manifest_fallback(tmp_path):
+    from music_analyst_tpu.observability.report import load_run
+
+    d = tmp_path / "run"
+    d.mkdir()
+    with open(d / "run_manifest.json", "w", encoding="utf-8") as fh:
+        json.dump(
+            {"serving": {"decode": {"ledger": _replica_ledger(1.0)}}}, fh
+        )
+    rec = load_run(str(d))
+    assert rec is not None
+    assert rec["engine_ledger"]["records"] == 0  # manifest, not jsonl
+    assert rec["engine_ledger"]["goodput_fraction"] == pytest.approx(
+        _replica_ledger(1.0)["goodput_fraction"]
+    )

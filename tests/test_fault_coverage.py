@@ -1,0 +1,250 @@
+"""Every fault site is drilled somewhere — the coverage roster.
+
+``resilience/faults.py`` SITES is the injection contract: a site nobody
+trips is a seam whose failure story is untested, and it rots silently
+when the call site moves.  Two scan tests hold the roster — every SITES
+member must appear in at least one bench/chaos scenario (``benchmarks/``)
+and at least one test (``tests/``) — and the micro-drills below close
+the gaps the roster found when it landed: ``compile.first``,
+``checkpoint.load``, ``loadgen.tick``, ``journal.append``,
+``journal.compact``, and ``serve.reply`` each get a direct
+inject → observe-degradation → recover exercise.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from music_analyst_tpu.resilience import (
+    configure_faults,
+    fault_stats,
+    reset_retry_stats,
+    retry_stats,
+)
+from music_analyst_tpu.resilience.faults import SITES
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------- roster scans
+
+
+def _corpus(directory, exclude_dirs=()):
+    chunks = []
+    for root, dirs, files in os.walk(directory):
+        dirs[:] = [d for d in dirs if d not in exclude_dirs]
+        for name in sorted(files):
+            if name.endswith(".py"):
+                path = os.path.join(root, name)
+                with open(path, "r", encoding="utf-8",
+                          errors="ignore") as fh:
+                    chunks.append(fh.read())
+    return "\n".join(chunks)
+
+
+def test_every_fault_site_appears_in_a_bench_scenario():
+    corpus = _corpus(os.path.join(_REPO, "benchmarks"),
+                     exclude_dirs=("results",))
+    missing = sorted(site for site in SITES if site not in corpus)
+    assert not missing, (
+        f"fault sites with no chaos/bench scenario: {missing} — add a "
+        "scenario in benchmarks/ (chaos.py is the usual home)"
+    )
+
+
+def test_every_fault_site_appears_in_a_test():
+    corpus = _corpus(os.path.join(_REPO, "tests"))
+    missing = sorted(site for site in SITES if site not in corpus)
+    assert not missing, (
+        f"fault sites with no test drill: {missing} — add one here or in "
+        "the subsystem's own test file"
+    )
+
+
+# ----------------------------------------------------------- compile.first
+
+
+def test_drill_compile_first_transient_retries_to_identical_result():
+    import jax.numpy as jnp
+
+    from music_analyst_tpu.profiling.compile import profiled_jit
+
+    x = jnp.arange(6, dtype=jnp.float32)
+    clean = np.asarray(
+        profiled_jit(lambda v: v * 2.0 - 1.0, name="fault_cov_clean")(x)
+    )
+    reset_retry_stats()
+    configure_faults("compile.first:error@1")
+    try:
+        fn = profiled_jit(lambda v: v * 2.0 - 1.0, name="fault_cov_fault")
+        faulted = np.asarray(fn(x))
+        trips = fault_stats()["compile.first"]["trips"]
+    finally:
+        configure_faults(None)
+    assert trips == 1
+    assert retry_stats().get("compile.first", {}).get("recoveries", 0) >= 1
+    assert np.array_equal(clean, faulted)
+
+
+# ---------------------------------------------------------- checkpoint.load
+
+
+def test_drill_checkpoint_load_transient_reruns_unit():
+    import jax
+
+    from music_analyst_tpu.engines.checkpoint import load_quantized_params
+
+    rng = np.random.default_rng(11)
+    weights = {
+        f"layer{i}": {"kernel": rng.standard_normal((8, 8)).astype(
+            np.float32)}
+        for i in range(2)
+    }
+
+    def _unit_source():
+        for unit, tree in weights.items():
+            yield unit, [(f"{unit}/kernel", tree["kernel"])]
+
+    def _leaves(tree):
+        return [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+
+    clean = _leaves(load_quantized_params(weights, _unit_source, "int8"))
+    configure_faults("checkpoint.load:error@1")
+    try:
+        faulted = _leaves(
+            load_quantized_params(weights, _unit_source, "int8")
+        )
+        trips = fault_stats()["checkpoint.load"]["trips"]
+    finally:
+        configure_faults(None)
+    assert trips == 1
+    assert len(clean) == len(faulted)
+    assert all(np.array_equal(a, b) for a, b in zip(clean, faulted))
+
+
+# -------------------------------------------------------------- loadgen.tick
+
+
+def test_drill_loadgen_tick_fault_drops_offered_request():
+    from benchmarks.loadgen import Arrival, LoadGen
+
+    class _Settled:
+        """Minimal ServeRequest stand-in: settles instantly, ok reply."""
+
+        def __init__(self):
+            self.done = True
+            self.response = {"ok": True, "label": "Neutral"}
+            self.t_enqueue = time.monotonic()
+            self.t_settle = self.t_enqueue
+
+        def wait(self, timeout=None):
+            return True
+
+    submitted = []
+
+    def _submit(rid, arrival):
+        submitted.append(rid)
+        return _Settled()
+
+    arrivals = [Arrival(t_s=0.0), Arrival(t_s=0.001), Arrival(t_s=0.002)]
+    configure_faults("loadgen.tick:error@2")
+    try:
+        report = LoadGen(_submit).replay(arrivals, settle_timeout_s=5.0)
+        trips = fault_stats()["loadgen.tick"]["trips"]
+    finally:
+        configure_faults(None)
+    # The faulted tick drops the *offered* request before submit — the
+    # target never sees a half-submitted request and the report says so.
+    assert trips == 1
+    assert report["ticks_faulted"] == 1
+    assert report["offered"] == 3
+    assert report["submitted"] == 2 and len(submitted) == 2
+    assert report["ok"] == 2
+    assert report["silent_drops"] == 0
+
+
+# ------------------------------------------------------------ journal.append
+
+
+def test_drill_journal_append_fault_counts_and_keeps_serving(tmp_path):
+    from music_analyst_tpu.serving.journal import RequestJournal
+
+    d = str(tmp_path / "wal")
+    j = RequestJournal(d, sync_every=1)
+    assert j.recover() == []
+    configure_faults("journal.append:error@1")
+    try:
+        j.record_admitted("a", "sentiment", "first verse")  # faulted
+        j.record_admitted("b", "sentiment", "second verse")  # lands
+        trips = fault_stats()["journal.append"]["trips"]
+    finally:
+        configure_faults(None)
+    stats = j.stats()
+    assert trips == 1
+    assert stats["append_errors"] == 1
+    assert stats["admitted"] == 1  # only the landed admit counted
+    # No torn state: the faulted admit never entered the replay index,
+    # so a restart re-dispatches exactly what was durably admitted.
+    j2 = RequestJournal(d, sync_every=1)
+    replay = j2.recover()
+    assert [r["id"] for r in replay] == ["b"]
+    j2.close()
+
+
+# ----------------------------------------------------------- journal.compact
+
+
+def test_drill_journal_compact_fault_leaves_replayable_state(tmp_path):
+    from music_analyst_tpu.serving.journal import RequestJournal
+
+    d = str(tmp_path / "wal")
+    j = RequestJournal(d, sync_every=1)
+    assert j.recover() == []
+    for rid in ("a", "b", "c"):
+        j.record_admitted(rid, "sentiment", f"verse {rid}")
+    j.record_replied("b", {"ok": True, "label": "Positive"})
+    configure_faults("journal.compact:error@1")
+    try:
+        # The seam fires after the compacted segment is published and
+        # before the sealed history is unlinked — both states replay
+        # identically (records are idempotent upserts).
+        j.compact()
+        trips = fault_stats()["journal.compact"]["trips"]
+    finally:
+        configure_faults(None)
+    assert trips == 1
+    assert j.stats()["append_errors"] == 1
+    # The journal keeps serving after the faulted compaction…
+    j.record_admitted("d", "sentiment", "verse d")
+    assert j.stats()["admitted"] == 4
+    # …and a restart on the same directory replays the merged state:
+    # old + compacted segments coexist, replay converges anyway.
+    j2 = RequestJournal(d, sync_every=1)
+    replay_ids = sorted(r["id"] for r in j2.recover())
+    assert replay_ids == ["a", "c", "d"]
+    assert j2.stats()["unclean_start"] is True  # never closed cleanly
+    j2.close()
+
+
+# --------------------------------------------------------------- serve.reply
+
+
+def test_drill_serve_reply_crash_accounts_and_dedups(tmp_path):
+    """Subprocess SIGKILL drill on the pre-reply seam: kill the journaled
+    mock server as it is about to answer, restart on the same journal
+    dir, re-send everything — 100% accounting, zero duplicate computes."""
+    from benchmarks.crash import _MOCK_ARGS, _mock_trace, run_drill
+
+    row = run_drill(
+        "pre_reply", "serve.reply:crash@4", str(tmp_path),
+        model_args=_MOCK_ARGS, trace=_mock_trace(8, seed=29),
+    )
+    assert row["killed_by_sigkill"] is True
+    assert row["recovered_exit_ok"] is True
+    assert row["all_accounted"] is True
+    assert row["loadgen_silent_drops"] == 0
+    assert row["duplicates_deduped"] is True
+    assert row["unclean_stamped"] is True
+    assert row["journal"]["unclean_start"] is True

@@ -213,7 +213,8 @@ smoke:
 		tests/test_router.py \
 		tests/test_journal.py tests/test_speculative.py \
 		tests/test_reqtrace.py tests/test_metrics_plane.py \
-		tests/test_engine_ledger.py tests/test_fault_coverage.py -q
+		tests/test_engine_ledger.py tests/test_fault_coverage.py \
+		tests/test_response_cache.py -q
 	# paged-attention kernel self-check (body in KERNEL_SELFCHECK above):
 	# both interpret-mode kernel bodies + the int8 path vs the f32 oracle.
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
@@ -291,6 +292,37 @@ print('smoke ok:', payload['metric'], payload['value'])"
 	print('serving self-check ok:', serving['requests']['batches'], 'batch(es)')" \
 		"$$servetmp/replies.ndjson" "$$servetmp/run_manifest.json" || \
 		{ echo "serving self-check failed"; exit 1; }
+	# response-cache self-check: the same sentiment request through two
+	# serve processes sharing one cache dir — the warm process must
+	# answer from the disk tier (stats: hits==1, ZERO batches dispatched,
+	# the hit never reaches the device) with a reply byte-identical to
+	# the cold one (the cache may never change output bytes; the `cached`
+	# stamp lives in stats/trace, never the payload).
+	rctmp=$$(mktemp -d) && trap 'rm -rf "$$rctmp"' EXIT && \
+	for run in cold warm; do \
+		printf '%s\n' \
+			'{"id":"c1","op":"sentiment","text":"I love this happy day"}' \
+			'{"id":"c2","op":"stats"}' | \
+		env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+			$(PY) -m music_analyst_tpu serve --stdio --mock --quiet \
+			--max-batch 2 --max-wait-ms 2 \
+			--response-cache-dir "$$rctmp/rcache" \
+			> "$$rctmp/$$run.ndjson" || \
+			{ echo "response-cache $$run run failed"; exit 1; }; \
+	done && \
+	$(PY) -c "import json,sys; \
+	cold=[json.loads(l) for l in open(sys.argv[1]) if l.strip()]; \
+	warm=[json.loads(l) for l in open(sys.argv[2]) if l.strip()]; \
+	sans=lambda r: {k:v for k,v in r.items() if k!='id'}; \
+	assert sans(warm[0])==sans(cold[0]), 'cached reply diverged from computed'; \
+	assert 'cached' not in warm[0], warm[0]; \
+	rc=warm[1]['stats']['response_cache']; \
+	assert rc['hits']==1 and rc['disk_hits']==1, rc; \
+	reqs=warm[1]['stats']['requests']; \
+	assert reqs['batches']==0 and reqs['rows']==0, reqs; \
+	print('response-cache self-check ok: 1 disk hit, 0 dispatches')" \
+		"$$rctmp/cold.ndjson" "$$rctmp/warm.ndjson" || \
+		{ echo "response-cache self-check failed"; exit 1; }
 	# generate-interleave self-check: one continuous-decode generate
 	# request sandwiched between two sentiment requests on the same
 	# stdio stream — replies must come back in order, the generate reply

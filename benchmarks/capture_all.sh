@@ -119,6 +119,11 @@ for suite in $suites; do
         elif [ "$suite" = "continuous" ] && ! grep -q '"paged_kernel"' "$tmp"; then
             rm -f "$tmp"
             echo "    REFUSED: continuous output lacks paged_kernel rows" >&2
+        # The serving capture must carry the Zipf response-cache A/B row
+        # (PERFORMANCE.md reads the warm-hit speedup table from it).
+        elif [ "$suite" = "serving" ] && ! grep -q '"response_cache"' "$tmp"; then
+            rm -f "$tmp"
+            echo "    REFUSED: serving output lacks response_cache row" >&2
         else
             mv "$tmp" "$out_dir/$suite.json"
             echo "    captured -> $out_dir/$suite.json" >&2

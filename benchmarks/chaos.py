@@ -1026,6 +1026,106 @@ def _ollama_request_scenario() -> dict:
     }
 
 
+def _response_cache_scenario(n_requests: int) -> dict:
+    """Injected response-cache I/O faults (sites ``response_cache.read``
+    and ``response_cache.write``): a faulted disk read degrades to
+    recompute — byte-identical replies, counted ``read_fallbacks``, the
+    on-disk entry NOT evicted (the next read may succeed) — and a
+    faulted publish leaves the settle uncached (``write_errors``).  The
+    cache can make an answer cheaper, never different."""
+    from music_analyst_tpu.resilience import configure_faults, fault_stats
+    from music_analyst_tpu.serving.batcher import DynamicBatcher
+    from music_analyst_tpu.serving.residency import ModelResidency
+    from music_analyst_tpu.serving.response_cache import ResponseCache
+    from music_analyst_tpu.serving.server import build_ops
+
+    residency = ModelResidency(model="mock", mock=True)
+    clf = residency.acquire()
+    residency.warmup(8)
+    ops = build_ops(clf)
+    texts = [
+        f"chaos cache lyric number {i} sunshine sorrow"
+        for i in range(n_requests)
+    ]
+
+    def _replies(cache):
+        batcher = DynamicBatcher(
+            ops, max_batch=8, max_wait_ms=2.0,
+            max_queue=n_requests + 1, response_cache=cache,
+        ).start()
+        reqs = [
+            batcher.submit(i, "sentiment", t)
+            for i, t in enumerate(texts)
+        ]
+        for req in reqs:
+            if not req.wait(timeout=60.0):
+                raise RuntimeError(f"request {req.id} never settled")
+        batcher.drain()
+        return [
+            {k: v for k, v in (req.response or {}).items() if k != "id"}
+            for req in reqs
+        ]
+
+    start = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="chaos_rcache_") as tmp:
+        rc_dir = os.path.join(tmp, "cache")
+        writer = ResponseCache(rc_dir, fingerprint="chaos")
+        clean = _replies(writer)  # cold: computes + publishes every entry
+        stores = writer.stats()["stores"]
+
+        # Faulted reads against a fresh instance (cold memory tier, so
+        # every lookup goes to disk): all degrade to recompute.
+        reader = ResponseCache(rc_dir, fingerprint="chaos")
+        configure_faults("response_cache.read:error@1+")
+        try:
+            faulted = _replies(reader)
+            read_trips = sum(
+                int(i.get("trips", 0)) for i in fault_stats().values()
+            )
+        finally:
+            configure_faults(None)
+        read_stats = reader.stats()
+
+        # Faulted publishes into an empty dir: replies settle uncached.
+        writer2 = ResponseCache(os.path.join(tmp, "wfault"),
+                                fingerprint="chaos")
+        configure_faults("response_cache.write:error@1+")
+        try:
+            wrote = _replies(writer2)
+            write_trips = sum(
+                int(i.get("trips", 0)) for i in fault_stats().values()
+            )
+        finally:
+            configure_faults(None)
+        write_stats = writer2.stats()
+    elapsed = time.perf_counter() - start
+
+    return {
+        "scenario": "response_cache_io",
+        "spec": ("response_cache.read:error@1+"
+                 ";response_cache.write:error@1+"),
+        "requests": n_requests,
+        "stores": stores,
+        "bytes_identical": faulted == clean and wrote == clean,
+        "read_fallbacks": read_stats["read_fallbacks"],
+        "hits_while_read_faulted": read_stats["hits"],
+        "entries_evicted_by_fault": read_stats["corrupt"],
+        "degraded_to_recompute": (
+            read_stats["read_fallbacks"] == n_requests
+            and read_stats["hits"] == 0
+            and read_stats["corrupt"] == 0
+        ),
+        "write_errors": write_stats["write_errors"],
+        "writes_degraded_uncached": (
+            write_stats["write_errors"] == n_requests
+            and write_stats["stores"] == 0
+        ),
+        "read_trips": read_trips,
+        "write_trips": write_trips,
+        "wall_s": round(elapsed, 4),
+    }
+
+
 @suite("chaos")
 def run() -> dict:
     from music_analyst_tpu.resilience import (
@@ -1193,6 +1293,15 @@ def run() -> dict:
             file=sys.stderr,
         )
 
+        response_cache = _response_cache_scenario(16 if smoke() else 128)
+        print(
+            f"[chaos] response_cache: identical="
+            f"{response_cache['bytes_identical']} "
+            f"read_fallbacks={response_cache['read_fallbacks']} "
+            f"write_errors={response_cache['write_errors']}",
+            file=sys.stderr,
+        )
+
         compile_first = _compile_first_scenario()
         print(
             f"[chaos] compile_first: recovered="
@@ -1236,6 +1345,7 @@ def run() -> dict:
         "metrics_scrape": metrics_scrape,
         "ledger_flush": ledger_flush,
         "cache_publish": cache_publish,
+        "response_cache": response_cache,
         "compile_first": compile_first,
         "checkpoint_stream": checkpoint_stream,
         "ollama_request": ollama_request,
@@ -1247,6 +1357,7 @@ def run() -> dict:
         and reqtrace_flush["bytes_identical"]
         and metrics_scrape["bytes_identical"]
         and ledger_flush["bytes_identical"]
+        and response_cache["bytes_identical"]
         and compile_first["bytes_identical"]
         and checkpoint_stream["bytes_identical"],
         "all_recovered": all(
@@ -1264,6 +1375,8 @@ def run() -> dict:
         and metrics_scrape["degraded_to_stale"]
         and ledger_flush["degraded_to_drops"]
         and cache_publish["recovered"]
+        and response_cache["degraded_to_recompute"]
+        and response_cache["writes_degraded_uncached"]
         and compile_first["recovered"]
         and checkpoint_stream["recovered"]
         and ollama_request["recovered"],

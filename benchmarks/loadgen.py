@@ -107,6 +107,69 @@ def poisson_arrivals(
     return out
 
 
+def zipf_catalog(catalog_size: int = 1000, seed: int = 0) -> List[str]:
+    """Deterministic catalog of distinct lyric-like request texts.
+
+    The ``track N`` suffix guarantees pairwise-distinct texts (and thus
+    distinct response-cache keys) even when the word draws collide."""
+    rng = random.Random(seed)
+    adjs = ("golden", "lonely", "silver", "broken", "velvet",
+            "midnight", "electric", "faded")
+    nouns = ("river", "night", "skies", "hearts", "radio",
+             "echo", "highway", "moonlight")
+    verbs = ("shines", "falls", "dances", "mends", "plays",
+             "drifts", "burns", "fades")
+    return [
+        (f"{rng.choice(adjs)} {rng.choice(nouns)} {rng.choice(verbs)} "
+         f"over the {rng.choice(adjs)} {rng.choice(nouns)} track {i}")
+        for i in range(catalog_size)
+    ]
+
+
+def zipf_arrivals(
+    rate_rps: float,
+    duration_s: float,
+    catalog_size: int = 1000,
+    s: float = 1.0,
+    seed: int = 0,
+    classes: Optional[Sequence[RequestClass]] = None,
+) -> List[Arrival]:
+    """Poisson arrivals whose texts repeat under a Zipf(``s``) popularity
+    law over a fixed seeded catalog — the response-cache workload.
+
+    Rank ``i`` (0-based) is drawn with probability proportional to
+    ``1/(i+1)**s``; at ``s≈1`` a small hot head dominates, so a
+    content-addressed cache converts most of the offered load into
+    hash-and-lookup hits.  Same seed → same catalog AND same draw
+    sequence, so cache-on and cache-off arms replay identical traces."""
+    if rate_rps <= 0.0 or catalog_size <= 0:
+        return []
+    catalog = zipf_catalog(catalog_size, seed=seed)
+    weights = [1.0 / float(i + 1) ** s for i in range(catalog_size)]
+    cum: List[float] = []
+    acc = 0.0
+    for w in weights:
+        acc += w
+        cum.append(acc)
+    total = cum[-1]
+    rng = random.Random(seed + 1)
+    out: List[Arrival] = []
+    t = rng.expovariate(rate_rps)
+    while t < duration_s:
+        arrival = _materialize(t, rng, classes)
+        r = rng.random() * total
+        lo, hi = 0, catalog_size - 1
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if cum[mid] < r:
+                lo = mid + 1
+            else:
+                hi = mid
+        out.append(replace(arrival, text=catalog[lo]))
+        t += rng.expovariate(rate_rps)
+    return out
+
+
 def diurnal_arrivals(
     base_rps: float,
     peak_rps: float,

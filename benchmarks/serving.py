@@ -15,11 +15,16 @@ Two contract rows ride along:
   ``max_batch=1`` baseline) by ≥ 2× (the ISSUE 8 acceptance bar);
 * **overload shedding** — a burst 4× the admission bound must shed with
   structured ``queue_full`` errors while every admitted request still
-  gets an answer and the server object survives.
+  gets an answer and the server object survives;
+* **response cache** — a Zipf(s≈1.0) catalog workload replayed against
+  the content-addressed response cache must beat the cache-off control
+  by ≥ 5× requests/s in the warm steady state (the ISSUE 20 bar), with
+  hit-path latency that never touches the device.
 """
 
 from __future__ import annotations
 
+import math
 import sys
 import time
 
@@ -55,6 +60,126 @@ def _drive(ops, max_batch: int, n_requests: int,
     elapsed = time.perf_counter() - start
     batcher.drain()
     return elapsed, batcher.stats(), reqs
+
+
+def _drive_texts(ops, texts, max_batch: int, response_cache=None,
+                 max_wait_ms: float = 2.0):
+    """Burst-submit an explicit text sequence; return wall, stats, reqs."""
+    import gc
+
+    from music_analyst_tpu.serving.batcher import DynamicBatcher
+
+    gc.collect()
+    batcher = DynamicBatcher(
+        ops, max_batch=max_batch, max_wait_ms=max_wait_ms,
+        max_queue=len(texts) + 1, response_cache=response_cache,
+    ).start()
+    start = time.perf_counter()
+    reqs = [
+        batcher.submit(i, "sentiment", text)
+        for i, text in enumerate(texts)
+    ]
+    for req in reqs:
+        if not req.wait(timeout=120.0):
+            raise RuntimeError(f"request {req.id} never settled")
+    elapsed = time.perf_counter() - start
+    batcher.drain()
+    return elapsed, batcher.stats(), reqs
+
+
+def _zipf_cache_scenario(ops, max_batch: int) -> dict:
+    """Zipf-catalog A/B: requests/s with the response cache (warm steady
+    state) vs the cache-off control over the identical arrival list.
+
+    The headline arms run at ``max_batch=1`` — per-dispatch serving,
+    what a cache hit actually skips.  (On the CPU-emulated mock the
+    keyword kernel's batched dispatch is ~tens of µs/request, the same
+    order as Python submit overhead, so a batched control understates
+    the win by construction; on real hardware a dispatch is ~ms.  The
+    batched control rides along as its own row for that comparison.)
+
+    The cache arm runs the same list twice — a cold pass that both
+    answers (head hits appear as soon as the first occurrence settles)
+    and populates, then a measured warm pass where every draw answers
+    from cache without a device dispatch.  Hit-path p99 comes from the
+    warm pass: a hash + dict lookup, far under any dispatch."""
+    import tempfile
+
+    from benchmarks.loadgen import _percentile, zipf_arrivals
+    from music_analyst_tpu.serving.response_cache import (
+        ResponseCache, backend_fingerprint,
+    )
+
+    n_draws = 800 if smoke() else 4000
+    arrivals = zipf_arrivals(
+        rate_rps=1000.0, duration_s=n_draws * 1.2 / 1000.0,
+        catalog_size=1000, s=1.0, seed=7,
+    )[:n_draws]
+    texts = [a.text for a in arrivals]
+
+    batched_s, _, _ = _drive_texts(ops, texts, max_batch=max_batch)
+    batched_rps = len(texts) / batched_s
+
+    with tempfile.TemporaryDirectory(prefix="musicaal-rcache-") as rc_dir:
+        cache = ResponseCache(
+            rc_dir, fingerprint=backend_fingerprint(model="mock"),
+        )
+        cold_s, _, _ = _drive_texts(
+            ops, texts, max_batch=1, response_cache=cache,
+        )
+        cold_stats = cache.stats()
+        cold_hit_rate = cold_stats["hit_rate"]
+        # Interleaved best-of-3 on both arms: the one-pinned-CPU sandbox
+        # has process-wide slow phases, so alternating the arms exposes
+        # them to the same conditions and the min-wall ratio stays a
+        # steady-state comparison rather than a scheduling lottery.
+        warm_texts = texts * 3  # longer timed interval, same mixture
+        off_s = math.inf
+        warm_s = math.inf
+        warm_batcher_stats = None
+        warm_reqs = []
+        for _ in range(3):
+            off_s = min(off_s, _drive_texts(ops, texts, max_batch=1)[0])
+            w_s, w_stats, w_reqs = _drive_texts(
+                ops, warm_texts, max_batch=1, response_cache=cache,
+            )
+            if w_s < warm_s:
+                warm_s, warm_batcher_stats, warm_reqs = w_s, w_stats, w_reqs
+        off_rps = len(texts) / off_s
+        warm_rps = len(warm_texts) / warm_s
+        hit_ms = sorted(
+            (r.t_settle - r.t_enqueue) * 1000.0
+            for r in warm_reqs
+            if r.t_settle is not None and r.meta.get("cached")
+        )
+        stats = cache.stats()
+
+    print(
+        f"[serving] zipf cache: control {off_rps:.0f} req/s → warm "
+        f"{warm_rps:.0f} req/s ({warm_rps / off_rps:.1f}x; batched "
+        f"control {batched_rps:.0f} req/s), cold hit rate "
+        f"{cold_hit_rate:.2f}, hit p99 "
+        f"{_percentile(hit_ms, 99.0):.3f} ms",
+        file=sys.stderr,
+    )
+    return {
+        "catalog_size": 1000,
+        "zipf_s": 1.0,
+        "draws": len(texts),
+        "unique_texts": len(set(texts)),
+        "control_requests_per_s": round(off_rps, 2),
+        "batched_control_max_batch": max_batch,
+        "batched_control_requests_per_s": round(batched_rps, 2),
+        "cold_seconds": round(cold_s, 4),
+        "cold_hit_rate": cold_hit_rate,
+        "warm_requests_per_s": round(warm_rps, 2),
+        "warm_speedup": round(warm_rps / off_rps, 2),
+        "warm_speedup_vs_batched": round(warm_rps / batched_rps, 2),
+        "warm_hits": warm_batcher_stats["cache_hits"],
+        "hit_p50_ms": round(_percentile(hit_ms, 50.0), 4),
+        "hit_p99_ms": round(_percentile(hit_ms, 99.0), 4),
+        "stats": stats,
+    }
 
 
 @suite("serving")
@@ -137,6 +262,8 @@ def run() -> dict:
         file=sys.stderr,
     )
 
+    response_cache = _zipf_cache_scenario(ops, max_batch=max(batch_grid))
+
     return {
         "suite": "serving",
         **device_info(),
@@ -152,4 +279,5 @@ def run() -> dict:
         "rows": rows,
         "coalescing_speedup": round(best_coalesced / seq_rps, 2),
         "overload": overload,
+        "response_cache": response_cache,
     }

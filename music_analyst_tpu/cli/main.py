@@ -426,6 +426,17 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                         "under --profile-dir, and feeds multi-window SLO "
                         "burn-rate alerts (default "
                         "$MUSICAAL_METRICS_INTERVAL_MS or 0 = off)")
+    p.add_argument("--response-cache-dir", default=None,
+                   help="Persistent response-cache directory: settled "
+                        "replies are content-addressed (normalized text + "
+                        "op + budget + backend fingerprint) and repeat "
+                        "requests answer from cache before shedding or "
+                        "tenant metering, byte-identical and without a "
+                        "device dispatch (default $MUSICAAL_RESPONSE_CACHE "
+                        "or ~/.cache/musicaal_responses)")
+    p.add_argument("--no-response-cache", action="store_true",
+                   help="Disable the response cache (every request "
+                        "computes)")
     _add_telemetry_flags(p)
 
 
@@ -749,6 +760,8 @@ def _dispatch(parser: argparse.ArgumentParser,
                 trace_sample=args.trace_sample,
                 trace_dir=args.profile_dir,
                 metrics_interval_ms=args.metrics_interval_ms,
+                response_cache_dir=args.response_cache_dir,
+                use_response_cache=not args.no_response_cache,
             )
             if resolve_replicas(args.replicas) > 1:
                 from music_analyst_tpu.serving.router import run_router

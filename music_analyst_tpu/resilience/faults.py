@@ -89,6 +89,12 @@ SITES = frozenset(
         # JSONL append degrades to a counted ``ledger_drops`` — replies
         # stay byte-identical and the file is never torn.
         "ledger.flush",
+        # Response-cache tiers (serving/response_cache.py): a faulted
+        # read counts a ``read_fallbacks`` and recomputes (byte-identical
+        # reply); a faulted write counts ``write_errors`` and the settle
+        # proceeds uncached.  Neither can fail or change a reply.
+        "response_cache.read",
+        "response_cache.write",
     }
 )
 

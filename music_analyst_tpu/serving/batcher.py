@@ -47,6 +47,11 @@ from music_analyst_tpu.observability import watchdog
 from music_analyst_tpu.resilience.failover import should_failover
 from music_analyst_tpu.resilience.faults import fault_point
 from music_analyst_tpu.resilience.policy import RetryPolicy
+from music_analyst_tpu.serving.response_cache import (
+    normalize_text,
+    populate_from_settle,
+    try_answer,
+)
 from music_analyst_tpu.serving.slo import FairQueue, RateMeter, TokenBucket
 from music_analyst_tpu.telemetry import get_telemetry
 from music_analyst_tpu.telemetry.core import Histogram
@@ -331,6 +336,12 @@ class ServeRequest:
             rt.on_complete(self, payload)
         self.t_settle = time.monotonic()
         self.response = payload
+        # Response-cache populate rides the same choke point: every
+        # settle route (batch dispatch, decode slot, dedup fan-out,
+        # router read-loop) stores a fresh ok reply through ONE seam —
+        # before the waiter wakes, so a hit is visible the moment the
+        # reply is.  No-op unless an admission edge parked a miss key.
+        populate_from_settle(self)
         self._done.set()
 
     def succeed(self, **fields: Any) -> None:
@@ -376,8 +387,13 @@ class DynamicBatcher:
         ttft_slo_ms: Optional[float] = None,
         tenant_budget: Optional[float] = None,
         priority: Optional[int] = None,
+        response_cache=None,
     ) -> None:
         self._ops = dict(ops)
+        # Cross-request response cache (serving/response_cache.py),
+        # consulted in submit() BEFORE the shed ladder and tenant
+        # metering; None leaves every request on the compute path.
+        self.response_cache = response_cache
         # Classified device loss during dispatch tries this hook ONCE per
         # batch (e.g. ModelResidency.reload) before the one-by-one
         # isolation fallback — the server survives a device death between
@@ -408,7 +424,7 @@ class DynamicBatcher:
             "admitted": 0, "shed": 0, "completed": 0, "failed": 0,
             "bad_request": 0, "batches": 0, "rows": 0, "padded_rows": 0,
             "queue_depth_max": 0, "isolation_retries": 0,
-            "failover_reloads": 0, "dedup_folded": 0,
+            "failover_reloads": 0, "dedup_folded": 0, "cache_hits": 0,
             "retry_after_ms_last": None,
             "shed_queue_full": 0, "shed_slo_unattainable": 0,
             "shed_tenant_budget": 0, "shed_evicted": 0,
@@ -484,6 +500,16 @@ class DynamicBatcher:
                 f"unknown op {op!r}; have: {sorted(self._ops)}",
             )
             self._bump(bad_request=1)
+            return req
+        # Response cache BEFORE the shed ladder and the tenant meter: a
+        # repeat of a settled request is answered for ~a hash + lookup —
+        # never queued, never charged to its tenant's token bucket, and
+        # a repeat that would shed queue_full/slo_unattainable is
+        # answered instead (a free answer beats a structured rejection).
+        if try_answer(self.response_cache, req):
+            self._bump(cache_hits=1)
+            self._rates["req_s"].mark()
+            tel.count("serving.cache_hits")
             return req
         with self._cond:
             if self._draining:
@@ -703,14 +729,19 @@ class DynamicBatcher:
         # batch functions over texts (same text → same payload), so this
         # is invisible on the wire and free occupancy when a burst repeats
         # itself (the same song submitted by many clients at once).
+        # Identity is normalize_text (shared with the decode-loop fold
+        # and the response-cache key) so every repeat-detection tier
+        # agrees on what "identical request" means; the first arrival's
+        # raw text is what actually dispatches.
         row_of: Dict[str, int] = {}
         rows: List[int] = []
         uniques: List[str] = []
         for req in batch:
-            idx = row_of.get(req.text)
+            row_key = normalize_text(req.text)
+            idx = row_of.get(row_key)
             if idx is None:
                 idx = len(uniques)
-                row_of[req.text] = idx
+                row_of[row_key] = idx
                 uniques.append(req.text)
             rows.append(idx)
         n_unique = len(uniques)
@@ -834,6 +865,8 @@ class DynamicBatcher:
                 "shed_s": self._rates["shed_s"].rate(),
             },
         )
+        if self.response_cache is not None:
+            out["response_cache"] = self.response_cache.stats()
         return out
 
     def slo_snapshot(self) -> Dict[str, Any]:

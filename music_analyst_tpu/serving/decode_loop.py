@@ -126,6 +126,7 @@ from music_analyst_tpu.serving.batcher import (
     resolve_tpot_slo_ms,
     resolve_ttft_slo_ms,
 )
+from music_analyst_tpu.serving.response_cache import normalize_text, try_answer
 from music_analyst_tpu.serving.slo import FairQueue, RateMeter, TokenBucket
 from music_analyst_tpu.telemetry import get_telemetry
 from music_analyst_tpu.telemetry.reqtrace import get_reqtrace
@@ -295,8 +296,14 @@ class ContinuousScheduler:
         speculate_k: Optional[int] = None,
         ledger_interval_ms: Optional[Any] = None,
         ledger_dir: Optional[str] = None,
+        response_cache=None,
     ) -> None:
         self.backend = backend
+        # Cross-request response cache (serving/response_cache.py),
+        # consulted in submit() BEFORE the shed ladder and tenant
+        # metering — a hit settles without a slot, a dispatch, or a
+        # chip-second; None leaves every request on the compute path.
+        self.response_cache = response_cache
         self.n_slots = resolve_slots(n_slots)
         self.prefill_chunk = resolve_prefill_chunk(prefill_chunk)
         self.max_queue = resolve_max_queue(max_queue)
@@ -406,7 +413,7 @@ class ContinuousScheduler:
             "tpot_slo_misses": 0, "retry_after_ms_last": None,
             "shed_queue_full": 0, "shed_slo_unattainable": 0,
             "shed_tenant_budget": 0, "shed_evicted": 0,
-            "dedup_folded": 0,
+            "dedup_folded": 0, "cache_hits": 0,
         }
         # Speculation counters (stats()["speculation"] → manifest
         # ``serving.decode.speculation``).
@@ -647,6 +654,17 @@ class ContinuousScheduler:
         )
         # Trace attach BEFORE the shed ladder: sheds carry trace ids too.
         get_reqtrace().begin_request(req)
+        # Response cache BEFORE the shed ladder and the tenant meter: a
+        # repeat of a settled generation is answered for ~a hash +
+        # lookup — no slot, no dispatch, no token-bucket charge, no
+        # ledger chip-seconds — and a repeat that would shed
+        # queue_full/slo_unattainable is answered instead.
+        if try_answer(self.response_cache, req, budget=budget):
+            with self._stats_lock:
+                self._stats["cache_hits"] += 1
+            self._rates["req_s"].mark()
+            tel.count("serving.decode_cache_hits")
+            return req
         with self._cond:
             if self._draining:
                 req.fail("draining", "server is draining; not admitting")
@@ -680,7 +698,10 @@ class ContinuousScheduler:
             # under each follower's own id.  Checked before capacity: a
             # fold consumes no queue depth, so it never evicts anyone.
             if op == "generate":
-                dedup_key = (req.tenant, text, budget)
+                # Identity is normalize_text — the same definition the
+                # batcher's row fold and the response-cache key use, so
+                # every repeat-detection tier agrees.
+                dedup_key = (req.tenant, normalize_text(text), budget)
                 primary = self._dedup_live.get(dedup_key)
                 if primary is not None and not primary.done:
                     primary.meta.setdefault(
@@ -2046,6 +2067,8 @@ class ContinuousScheduler:
         # ``serving.decode.ledger``; flattened counters merge fleet-wide
         # through the metrics plane's stats-poll ingest).
         out["ledger"] = self._ledger.snapshot()
+        if self.response_cache is not None:
+            out["response_cache"] = self.response_cache.stats()
         return out
 
     def _ledger_occupancy_sample(self) -> Dict[str, Any]:

@@ -74,6 +74,13 @@ from music_analyst_tpu.observability.metrics_plane import (
     configure_metrics,
     get_metrics_plane,
 )
+from music_analyst_tpu.serving.response_cache import (
+    ResponseCache,
+    backend_fingerprint,
+    checkpoint_stamp,
+    resolve_response_cache_dir,
+    try_answer,
+)
 from music_analyst_tpu.serving.slo import FairQueue, RateMeter, TokenBucket
 from music_analyst_tpu.telemetry import get_telemetry
 from music_analyst_tpu.telemetry.reqtrace import (
@@ -350,9 +357,15 @@ class ReplicaRouter:
         ttft_slo_ms: Optional[float] = None,
         tenant_budget: Optional[float] = None,
         priority: Optional[int] = None,
+        response_cache=None,
     ) -> None:
         if not replicas:
             raise ValueError("router needs at least one replica")
+        # Cross-request response cache (serving/response_cache.py),
+        # consulted in submit() BEFORE the shed ladder and tenant
+        # metering — a hit never reaches a replica; None leaves every
+        # request on the forward path.
+        self.response_cache = response_cache
         self.replicas = list(replicas)
         self.max_queue = resolve_max_queue(max_queue)
         self.poll_interval_s = float(poll_interval_s)
@@ -375,7 +388,7 @@ class ReplicaRouter:
             "admitted": 0, "shed": 0, "completed": 0, "failed": 0,
             "bad_request": 0, "dispatched": 0, "requeued": 0,
             "queue_depth_max": 0, "retry_after_ms_last": None,
-            "respawns": 0, "respawn_failures": 0,
+            "respawns": 0, "respawn_failures": 0, "cache_hits": 0,
             "shed_queue_full": 0, "shed_slo_unattainable": 0,
             "shed_tenant_budget": 0, "shed_evicted": 0,
         }
@@ -466,6 +479,17 @@ class ReplicaRouter:
             req.fail("bad_request",
                      f"unknown op {op!r}; have: {sorted(_FORWARD_OPS)}")
             self._bump(bad_request=1)
+            return req
+        # Response cache BEFORE the shed ladder and the tenant meter: a
+        # repeat of a settled request is answered at the router front —
+        # no replica hop, no token-bucket charge — and a repeat that
+        # would shed queue_full/slo_unattainable is answered instead.
+        budget = req.meta.get("max_new_tokens")
+        if try_answer(self.response_cache, req,
+                      budget=None if budget is None else int(budget)):
+            self._bump(cache_hits=1)
+            self._rates["req_s"].mark()
+            tel.count("router.cache_hits")
             return req
         with self._cond:
             if self._draining:
@@ -915,6 +939,8 @@ class ReplicaRouter:
             health_transitions=transitions,
             replicas={h.name: h.snapshot() for h in self.replicas},
         )
+        if self.response_cache is not None:
+            out["response_cache"] = self.response_cache.stats()
         return out
 
     def slo_snapshot(self) -> Dict[str, Any]:
@@ -962,6 +988,7 @@ def _replica_cmd(
     page_size: Optional[int],
     kv_pages: Optional[int],
     warmup: bool,
+    kv_quant: Optional[str] = None,
     speculate_k: Optional[int] = None,
     ttft_slo_ms: Optional[float] = None,
     tpot_slo_ms: Optional[float] = None,
@@ -970,6 +997,8 @@ def _replica_cmd(
     journal_dir: Optional[str] = None,
     trace_sample: Optional[float] = None,
     metrics_interval_ms: Optional[float] = None,
+    response_cache_dir: Optional[str] = None,
+    use_response_cache: bool = True,
 ) -> List[str]:
     cmd = [
         sys.executable, "-m", "music_analyst_tpu", "serve",
@@ -990,6 +1019,7 @@ def _replica_cmd(
         ("--prefill-chunk", prefill_chunk),
         ("--page-size", page_size),
         ("--kv-pages", kv_pages),
+        ("--kv-quant", kv_quant),
         ("--speculate-k", speculate_k),
         ("--ttft-slo-ms", ttft_slo_ms),
         ("--tpot-slo-ms", tpot_slo_ms),
@@ -1004,11 +1034,17 @@ def _replica_cmd(
         # $MUSICAAL_METRICS_* from configure_metrics, the explicit flag
         # survives a scrubbed environment.
         ("--metrics-interval-ms", metrics_interval_ms),
+        # Workers keep their own edge caches; an explicit dir flows
+        # through so the fleet shares one on-disk tier across replicas
+        # (content-addressed entries make concurrent publishers safe).
+        ("--response-cache-dir", response_cache_dir),
     ):
         if value is not None:
             cmd += [flag, str(value)]
     if not warmup:
         cmd.append("--no-warmup")
+    if not use_response_cache:
+        cmd.append("--no-response-cache")
     return cmd
 
 
@@ -1028,6 +1064,7 @@ def spawn_replicas(
     max_new_tokens: int = 16,
     page_size: Optional[int] = None,
     kv_pages: Optional[int] = None,
+    kv_quant: Optional[str] = None,
     speculate_k: Optional[int] = None,
     warmup: bool = True,
     connect: bool = True,
@@ -1038,6 +1075,8 @@ def spawn_replicas(
     journal_dir: Optional[str] = None,
     trace_sample: Optional[float] = None,
     metrics_interval_ms: Optional[float] = None,
+    response_cache_dir: Optional[str] = None,
+    use_response_cache: bool = True,
 ) -> List[ReplicaHandle]:
     """Start ``n`` worker server processes and (optionally) connect.
 
@@ -1065,12 +1104,14 @@ def spawn_replicas(
                 socket_path, model, mock, weight_quant, tp, max_batch,
                 max_wait_ms, max_queue, slots, prefill_chunk,
                 max_new_tokens, page_size, kv_pages, warmup,
-                speculate_k=speculate_k,
+                kv_quant=kv_quant, speculate_k=speculate_k,
                 ttft_slo_ms=ttft_slo_ms, tpot_slo_ms=tpot_slo_ms,
                 tenant_budget=tenant_budget, priority=priority,
                 journal_dir=replica_journal,
                 trace_sample=trace_sample,
                 metrics_interval_ms=metrics_interval_ms,
+                response_cache_dir=response_cache_dir,
+                use_response_cache=use_response_cache,
             )
             proc = subprocess.Popen(
                 cmd,
@@ -1111,6 +1152,7 @@ def run_router(
     max_new_tokens: int = 16,
     page_size: Optional[int] = None,
     kv_pages: Optional[int] = None,
+    kv_quant: Optional[str] = None,
     speculate_k: Optional[int] = None,
     ttft_slo_ms: Optional[float] = None,
     tpot_slo_ms: Optional[float] = None,
@@ -1120,6 +1162,8 @@ def run_router(
     trace_sample: Optional[Any] = None,
     trace_dir: Optional[str] = None,
     metrics_interval_ms: Optional[Any] = None,
+    response_cache_dir: Optional[str] = None,
+    use_response_cache: bool = True,
 ) -> int:
     """``serve --replicas N`` (N > 1): spawn the fleet, route until
     drained.  The front end is a stock ``SentimentServer`` with the
@@ -1159,10 +1203,13 @@ def run_router(
                 max_queue=max_queue, slots=slots,
                 prefill_chunk=prefill_chunk,
                 max_new_tokens=max_new_tokens, page_size=page_size,
-                kv_pages=kv_pages, speculate_k=speculate_k, warmup=warmup,
+                kv_pages=kv_pages, kv_quant=kv_quant,
+                speculate_k=speculate_k, warmup=warmup,
                 ttft_slo_ms=ttft_slo_ms, tpot_slo_ms=tpot_slo_ms,
                 tenant_budget=tenant_budget, priority=priority,
                 journal_dir=journal_base,
+                response_cache_dir=response_cache_dir,
+                use_response_cache=use_response_cache,
                 trace_sample=(
                     reqtrace.sample if reqtrace.enabled else None
                 ),
@@ -1170,9 +1217,33 @@ def run_router(
                     metrics.interval_ms if metrics.enabled else None
                 ),
             )
+            # Response cache at the router front: a hit never reaches a
+            # replica, so it costs the fleet nothing.  The fingerprint
+            # covers everything the front knows that changes reply bytes;
+            # keys are disjoint from the replicas' own edge caches (their
+            # fingerprints add backend identity), which is harmless --
+            # each tier answers from what it has seen settle.
+            rc_dir = resolve_response_cache_dir(
+                response_cache_dir, use_response_cache
+            )
+            response_cache = None
+            if rc_dir is not None:
+                response_cache = ResponseCache(
+                    rc_dir,
+                    fingerprint=backend_fingerprint(
+                        model=model,
+                        mock=bool(mock),
+                        weight_quant=weight_quant or "none",
+                        kv_quant=kv_quant or "none",
+                        max_new_tokens=int(max_new_tokens),
+                        tp=tp_width,
+                        checkpoint=checkpoint_stamp(),
+                    ),
+                )
             router = ReplicaRouter(
                 handles, max_queue=max_queue, ttft_slo_ms=ttft_slo_ms,
                 tenant_budget=tenant_budget, priority=priority,
+                response_cache=response_cache,
             ).start()
             server = SentimentServer(
                 router, mode="stdio" if stdio else "unix",
@@ -1188,6 +1259,8 @@ def run_router(
             )
             if journal_base:
                 tel.annotate(journal_dir=journal_base)
+            if rc_dir:
+                tel.annotate(response_cache_dir=rc_dir)
             if not quiet:
                 print(
                     f"serve: routing over {n} replica(s) (tp={tp_width})",

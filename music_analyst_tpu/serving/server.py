@@ -65,6 +65,12 @@ from music_analyst_tpu.serving.journal import (
     resolve_journal_dir,
 )
 from music_analyst_tpu.serving.residency import ModelResidency
+from music_analyst_tpu.serving.response_cache import (
+    ResponseCache,
+    backend_fingerprint,
+    checkpoint_stamp,
+    resolve_response_cache_dir,
+)
 from music_analyst_tpu.telemetry import get_telemetry
 from music_analyst_tpu.observability.metrics_plane import (
     configure_metrics,
@@ -520,6 +526,13 @@ class SentimentServer:
             out["router"] = self.router.stats()
         if self.journal is not None:
             out["journal"] = self.journal.stats()
+        # Response cache (serving/response_cache.py) — one instance is
+        # shared by whichever admission edges exist; only-when-used.
+        for edge in (self.batcher, self.decode, self.router):
+            cache = getattr(edge, "response_cache", None)
+            if cache is not None:
+                out["response_cache"] = cache.stats()
+                break
         rt = get_reqtrace()
         if rt.enabled:
             out["reqtrace"] = rt.stats()
@@ -661,6 +674,8 @@ def run_server(
     tpot_slo_ms: Optional[float] = None,
     tenant_budget: Optional[float] = None,
     priority: Optional[int] = None,
+    response_cache_dir: Optional[str] = None,
+    use_response_cache: bool = True,
     journal_dir: Optional[str] = None,
     trace_sample: Optional[Any] = None,
     trace_dir: Optional[str] = None,
@@ -721,6 +736,29 @@ def run_server(
             backend=backend, mesh=serve_mesh(tp),
         )
         clf = residency.acquire()
+        # Response cache (serving/response_cache.py): ONE instance shared
+        # by every admission edge this server stands up.  The fingerprint
+        # folds in everything that changes reply bytes — model identity,
+        # checkpoint stamp, quant schemes, the decode budget clamp — so a
+        # cache dir shared across configurations can never cross replies.
+        rc_dir = resolve_response_cache_dir(
+            response_cache_dir, use_response_cache
+        )
+        response_cache = None
+        if rc_dir is not None:
+            response_cache = ResponseCache(
+                rc_dir,
+                fingerprint=backend_fingerprint(
+                    model=model,
+                    backend=getattr(clf, "name", "injected"),
+                    mock=bool(mock),
+                    weight_quant=weight_quant or "none",
+                    kv_quant=kv_quant or "none",
+                    max_new_tokens=int(max_new_tokens),
+                    tp=resolve_tp(tp),
+                    checkpoint=checkpoint_stamp(),
+                ),
+            )
         if warmup:
             record = residency.warmup(resolved_batch)
             if not quiet:
@@ -739,6 +777,7 @@ def run_server(
             ttft_slo_ms=ttft_slo_ms,
             tenant_budget=tenant_budget,
             priority=priority,
+            response_cache=response_cache,
         ).start()
         # Continuous decode runtime for the ``generate`` op — only when
         # the backend exposes a slot runtime (capability probe) and slots
@@ -763,6 +802,7 @@ def run_server(
                 tpot_slo_ms=tpot_slo_ms,
                 tenant_budget=tenant_budget,
                 priority=priority,
+                response_cache=response_cache,
                 # Engine ledger: flushes to the same profile dir on the
                 # metrics cadence ($MUSICAAL_LEDGER_* override either).
                 ledger_dir=trace_dir,
@@ -806,6 +846,7 @@ def run_server(
             decode_slots=(decode.plan.n_slots if decode is not None else 0),
             serve_tp=resolve_tp(tp),
             journal_dir=journal_path,
+            response_cache_dir=rc_dir,
         )
 
         # Graceful SIGTERM/SIGINT: drain instead of dying.  The flight

@@ -29,6 +29,12 @@ os.environ["MUSICAAL_CORPUS_CACHE"] = tempfile.mkdtemp(
 os.environ["MUSICAAL_WQ_CACHE"] = tempfile.mkdtemp(
     prefix="musicaal-test-wq-cache-"
 )
+# The response cache (serving/response_cache.py) is OFF under tests:
+# unlike the artifact caches above, a hit changes serving *counters*
+# (completed/batches/rows) that serving tests assert on, so even a
+# per-session tmpdir would couple tests that reuse a lyric.  Tests that
+# exercise the cache pass an explicit directory, which wins over this.
+os.environ["MUSICAAL_RESPONSE_CACHE"] = "off"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

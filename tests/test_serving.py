@@ -574,9 +574,11 @@ def test_sigterm_mid_batch_drains_gracefully(tmp_path):
 
 
 def test_serving_bench_suite_meets_acceptance(monkeypatch):
-    """The ISSUE 8 acceptance bar, pinned: coalesced throughput ≥ 2×
-    sequential at offered load ≥ max_batch; overload sheds with
-    structured queue_full errors and every request still gets a reply."""
+    """The ISSUE 8 + ISSUE 20 acceptance bars, pinned: coalesced
+    throughput ≥ 2× sequential at offered load ≥ max_batch; overload
+    sheds with structured queue_full errors and every request still
+    gets a reply; the warm Zipf response-cache replay ≥ 5× the
+    cache-off control with hit-path latency that never saw a dispatch."""
     monkeypatch.setenv("MUSICAAL_BENCH_SMOKE", "1")
     import benchmarks
 
@@ -589,3 +591,9 @@ def test_serving_bench_suite_meets_acceptance(monkeypatch):
     for row in table["rows"]:
         assert row["p50_s"] is not None
         assert row["p99_s"] >= row["p50_s"]
+    rc = table["response_cache"]
+    assert rc["warm_speedup"] >= 5.0
+    assert rc["warm_hits"] == rc["draws"] * 3  # warm replay: all hits
+    assert rc["cold_hit_rate"] > 0.0  # head repeats answer mid-cold-pass
+    assert rc["hit_p99_ms"] < 1.0  # hash + dict lookup, no dispatch
+    assert rc["stats"]["corrupt"] == 0 and rc["stats"]["write_errors"] == 0
